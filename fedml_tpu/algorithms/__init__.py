@@ -1,0 +1,1 @@
+from .builtin import build_algorithm  # noqa: F401

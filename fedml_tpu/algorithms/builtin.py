@@ -1,0 +1,319 @@
+"""Built-in federated optimizers as FedAlgorithm instances.
+
+Covers the reference's algorithm family (reference: python/fedml/simulation/sp/
+{fedavg,fedprox,fedopt,fednova,scaffold,feddyn,mime}/ — ~4,900 LoC of
+process-oriented trainers) as ~400 lines of pure step/update functions. Each
+algorithm differs from FedAvg only in (a) a per-step gradient correction,
+(b) the shape of the client update payload, and/or (c) the server merge rule —
+the contract in core/algorithm.py captures exactly those three degrees of
+freedom, matching how the reference's agg_operator special-cases payloads
+(reference: ml/aggregator/agg_operator.py:103-121 SCAFFOLD 3-tuple branch).
+
+All are registered in ALGORITHMS under the reference's `federated_optimizer`
+names (FedAvg/FedProx/FedOpt/FedNova/SCAFFOLD/FedDyn/Mime).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import TrainArgs
+from ..core.algorithm import (
+    ClientMetrics,
+    FedAlgorithm,
+    ServerState,
+    local_sgd,
+    make_batch_indices,
+    make_client_optimizer,
+)
+from ..core.registry import ALGORITHMS
+from ..ops import tree as tu
+
+Pytree = Any
+
+
+def _server_optimizer(name: str, lr: float, momentum: float) -> optax.GradientTransformation:
+    """FedOpt's server optimizer menu (reference: sp/fedopt/optrepo.py:7 reflects
+    over torch.optim; here an explicit optax menu — adds yogi, which the FedOpt
+    paper actually recommends for FL)."""
+    name = (name or "sgd").lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum if momentum else None)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "yogi":
+        return optax.yogi(lr)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    raise ValueError(f"unknown server_optimizer {name!r}")
+
+
+def _make_client_sgd(apply_fn, t: TrainArgs, grad_correction_factory=None):
+    """Shared client body: sample batch indices, run local SGD, return delta.
+
+    grad_correction_factory(bcast, client_state) -> (g, p) -> g  lets each
+    algorithm inject its per-step correction without re-writing the loop.
+    """
+    opt = make_client_optimizer(
+        t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
+    )
+
+    def run(bcast, shard, client_state, rng):
+        idx = make_batch_indices(rng, shard["y"].shape[0], t.batch_size, t.epochs)
+        corr = (
+            grad_correction_factory(bcast, client_state)
+            if grad_correction_factory is not None
+            else None
+        )
+        new_params, metrics, tau = local_sgd(
+            apply_fn, bcast["params"], shard, idx, opt, corr
+        )
+        delta = tu.tree_sub(new_params, bcast["params"])
+        return delta, metrics, tau
+
+    return run
+
+
+# ---------------------------------------------------------------- FedAvg / FedOpt
+def make_fedopt(apply_fn, t: TrainArgs, server_opt_name=None) -> FedAlgorithm:
+    """FedOpt (Reddi et al.): server treats -mean_delta as a pseudo-gradient.
+    FedAvg == FedOpt with SGD(lr=server_lr, default 1.0): applying the mean
+    delta IS averaging the local models (reference: sp/fedavg/fedavg_api.py:144)."""
+    opt = _server_optimizer(
+        server_opt_name or t.server_optimizer, t.server_lr, t.server_momentum
+    )
+    base = _make_client_sgd(apply_fn, t)
+
+    def server_init(params, _cfg=None):
+        return ServerState(params, opt.init(params), jnp.int32(0), None)
+
+    def client_update(bcast, shard, client_state, rng):
+        delta, metrics, _tau = base(bcast, shard, client_state, rng)
+        return delta, client_state, metrics
+
+    def server_update(st: ServerState, mean_delta: Pytree) -> ServerState:
+        grad = tu.tree_scale(mean_delta, -1.0)  # descent direction -> pseudo-grad
+        updates, opt_state = opt.update(grad, st.opt_state, st.params)
+        params = optax.apply_updates(st.params, updates)
+        return st.replace(params=params, opt_state=opt_state, round=st.round + 1)
+
+    return FedAlgorithm("FedOpt", server_init, client_update, server_update)
+
+
+def make_fedavg(apply_fn, t: TrainArgs) -> FedAlgorithm:
+    import dataclasses as _dc
+    alg = make_fedopt(apply_fn, _dc.replace(t, server_optimizer="sgd"), "sgd")
+    return _dc.replace(alg, name="FedAvg")
+
+
+# ---------------------------------------------------------------- FedProx
+def make_fedprox(apply_fn, t: TrainArgs) -> FedAlgorithm:
+    """FedProx: local loss += (mu/2)||w - w_global||^2, i.e. g += mu(w - w_g)
+    (reference: sp/fedprox/ — the proximal term in the client loss)."""
+    mu = t.fedprox_mu
+
+    def corr_factory(bcast, _state):
+        gp = bcast["params"]
+        return lambda g, p: tu.tree_add(g, tu.tree_scale(tu.tree_sub(p, gp), mu))
+
+    base = _make_client_sgd(apply_fn, t, corr_factory)
+    avg = make_fedavg(apply_fn, t)
+
+    def client_update(bcast, shard, client_state, rng):
+        delta, metrics, _ = base(bcast, shard, client_state, rng)
+        return delta, client_state, metrics
+
+    import dataclasses as _dc
+    return _dc.replace(avg, name="FedProx", client_update=client_update)
+
+
+# ---------------------------------------------------------------- FedNova
+def make_fednova(apply_fn, t: TrainArgs) -> FedAlgorithm:
+    """FedNova (Wang et al.): normalize each client's delta by its effective
+    local step count tau_i, then rescale the mean by tau_eff — removes
+    objective inconsistency under heterogeneous local work
+    (reference: sp/fednova/, mpi/fednova/)."""
+    base = _make_client_sgd(apply_fn, t)
+
+    def server_init(params, _cfg=None):
+        return ServerState(params, None, jnp.int32(0), None)
+
+    def client_update(bcast, shard, client_state, rng):
+        delta, metrics, tau = base(bcast, shard, client_state, rng)
+        tau = jnp.maximum(tau, 1.0)
+        norm_delta = tu.tree_scale(delta, 1.0 / tau)
+        return {"d": norm_delta, "tau": tau}, client_state, metrics
+
+    def server_update(st: ServerState, agg) -> ServerState:
+        # agg = weighted means of {d, tau}; w += server_lr * tau_eff * mean(d)
+        params = tu.tree_add(
+            st.params, tu.tree_scale(agg["d"], t.server_lr * agg["tau"])
+        )
+        return st.replace(params=params, round=st.round + 1)
+
+    return FedAlgorithm("FedNova", server_init, client_update, server_update)
+
+
+# ---------------------------------------------------------------- SCAFFOLD
+def make_scaffold(apply_fn, t: TrainArgs, client_num_in_total: int,
+                  client_num_per_round: int) -> FedAlgorithm:
+    """SCAFFOLD (Karimireddy et al.): control variates c (server) and c_i
+    (per-client, persistent). Per-step grad correction g - c_i + c; after K
+    steps c_i' = c_i - c + (w_g - w_local)/(K * lr). Client update payload is
+    the (delta_w, delta_c) pair — the reference encodes this as a 3-tuple
+    through its agg operator (reference: agg_operator.py:103-121).
+    """
+    base_opt = make_client_optimizer(
+        t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
+    )
+    frac = client_num_per_round / max(client_num_in_total, 1)
+
+    def corr_factory(bcast, client_state):
+        c = bcast["extra"]
+        c_i = client_state
+        return lambda g, p: tu.tree_add(g, tu.tree_sub(c, c_i))
+
+    def server_init(params, _cfg=None):
+        return ServerState(params, None, jnp.int32(0), tu.tree_zeros_like(params))
+
+    def client_update(bcast, shard, client_state, rng):
+        idx = make_batch_indices(rng, shard["y"].shape[0], t.batch_size, t.epochs)
+        corr = corr_factory(bcast, client_state)
+        new_params, metrics, tau = local_sgd(
+            apply_fn, bcast["params"], shard, idx, base_opt, corr
+        )
+        delta = tu.tree_sub(new_params, bcast["params"])
+        k_lr = jnp.maximum(tau, 1.0) * t.learning_rate
+        # c_i' = c_i - c - delta/(K*lr)
+        new_ci = tu.tree_sub(
+            tu.tree_sub(client_state, bcast["extra"]), tu.tree_scale(delta, 1.0 / k_lr)
+        )
+        dc = tu.tree_sub(new_ci, client_state)
+        return {"delta": delta, "dc": dc}, new_ci, metrics
+
+    def server_update(st: ServerState, agg) -> ServerState:
+        params = tu.tree_add(st.params, tu.tree_scale(agg["delta"], t.server_lr))
+        c = tu.tree_add(st.extra, tu.tree_scale(agg["dc"], frac))
+        return st.replace(params=params, extra=c, round=st.round + 1)
+
+    return FedAlgorithm(
+        "SCAFFOLD", server_init, client_update, server_update,
+        client_state_init=tu.tree_zeros_like,
+    )
+
+
+# ---------------------------------------------------------------- FedDyn
+def make_feddyn(apply_fn, t: TrainArgs, client_num_in_total: int,
+                client_num_per_round: int) -> FedAlgorithm:
+    """FedDyn (Acar et al.): dynamic regularizer. Client risk +=
+    -<h_i, w> + (alpha/2)||w - w_g||^2 => g - h_i + alpha (w - w_g);
+    h_i' = h_i - alpha * delta_i. Server: h -= alpha*(m/N)*mean_delta;
+    w = w + mean_delta - h/alpha (reference: sp/feddyn/)."""
+    alpha = t.feddyn_alpha
+    frac = client_num_per_round / max(client_num_in_total, 1)
+
+    def corr_factory(bcast, client_state):
+        gp = bcast["params"]
+        h_i = client_state
+        return lambda g, p: tu.tree_add(
+            tu.tree_sub(g, h_i), tu.tree_scale(tu.tree_sub(p, gp), alpha)
+        )
+
+    base = _make_client_sgd(apply_fn, t, corr_factory)
+
+    def server_init(params, _cfg=None):
+        return ServerState(params, None, jnp.int32(0), tu.tree_zeros_like(params))
+
+    def client_update(bcast, shard, client_state, rng):
+        delta, metrics, _ = base(bcast, shard, client_state, rng)
+        new_hi = tu.tree_sub(client_state, tu.tree_scale(delta, alpha))
+        return delta, new_hi, metrics
+
+    def server_update(st: ServerState, mean_delta) -> ServerState:
+        h = tu.tree_sub(st.extra, tu.tree_scale(mean_delta, alpha * frac))
+        params = tu.tree_sub(
+            tu.tree_add(st.params, mean_delta), tu.tree_scale(h, 1.0 / alpha)
+        )
+        return st.replace(params=params, extra=h, round=st.round + 1)
+
+    return FedAlgorithm(
+        "FedDyn", server_init, client_update, server_update,
+        client_state_init=tu.tree_zeros_like,
+    )
+
+
+# ---------------------------------------------------------------- MimeLite
+def make_mime(apply_fn, t: TrainArgs) -> FedAlgorithm:
+    """MimeLite (Karimireddy et al.): clients run SGD-with-momentum where the
+    momentum buffer is the *server's*, applied but never updated locally; the
+    server refreshes momentum from the mean full-batch gradient at the global
+    params (reference: sp/mime/)."""
+    beta = t.mime_beta
+
+    def server_init(params, _cfg=None):
+        return ServerState(
+            params, None, jnp.int32(0), {"m": tu.tree_zeros_like(params)}
+        )
+
+    def client_update(bcast, shard, client_state, rng):
+        m = bcast["extra"]["m"]
+        idx = make_batch_indices(rng, shard["y"].shape[0], t.batch_size, t.epochs)
+
+        # frozen-momentum SGD: step direction beta*m + (1-beta)*g
+        mom_opt = optax.sgd(t.learning_rate)
+
+        def corr(g, p):
+            return tu.tree_add(tu.tree_scale(m, beta), tu.tree_scale(g, 1.0 - beta))
+
+        new_params, metrics, _ = local_sgd(
+            apply_fn, bcast["params"], shard, idx, mom_opt, corr
+        )
+        delta = tu.tree_sub(new_params, bcast["params"])
+
+        # full-batch gradient at the GLOBAL params for the momentum refresh
+        def loss_fn(p):
+            from ..core.algorithm import masked_softmax_ce
+            logits = apply_fn({"params": p}, shard["x"])
+            loss, _, _ = masked_softmax_ce(logits, shard["y"], shard["mask"])
+            return loss
+
+        full_grad = jax.grad(loss_fn)(bcast["params"])
+        return {"delta": delta, "g": full_grad}, client_state, metrics
+
+    def server_update(st: ServerState, agg) -> ServerState:
+        m = tu.tree_add(
+            tu.tree_scale(st.extra["m"], beta), tu.tree_scale(agg["g"], 1.0 - beta)
+        )
+        params = tu.tree_add(st.params, tu.tree_scale(agg["delta"], t.server_lr))
+        return st.replace(params=params, extra={"m": m}, round=st.round + 1)
+
+    return FedAlgorithm("Mime", server_init, client_update, server_update)
+
+
+# ---------------------------------------------------------------- factory
+def build_algorithm(name: str, apply_fn: Callable, t: TrainArgs,
+                    client_num_in_total: int | None = None,
+                    client_num_per_round: int | None = None) -> FedAlgorithm:
+    """federated_optimizer name -> FedAlgorithm (reference: runner dispatch +
+    trainer_creator keyed on args.federated_optimizer)."""
+    n_total = client_num_in_total or 1
+    n_round = client_num_per_round or 1
+    key = name.lower()
+    if key == "fedavg":
+        return make_fedavg(apply_fn, t)
+    if key == "fedopt":
+        return make_fedopt(apply_fn, t)
+    if key == "fedprox":
+        return make_fedprox(apply_fn, t)
+    if key == "fednova":
+        return make_fednova(apply_fn, t)
+    if key == "scaffold":
+        return make_scaffold(apply_fn, t, n_total, n_round)
+    if key == "feddyn":
+        return make_feddyn(apply_fn, t, n_total, n_round)
+    if key in ("mime", "mimelite"):
+        return make_mime(apply_fn, t)
+    raise ValueError(f"unknown federated_optimizer {name!r}")

@@ -1,0 +1,186 @@
+"""Functional federated-algorithm contract + shared local-training machinery.
+
+TPU-native replacement for the reference operator ABCs:
+- `ClientTrainer.train()` (reference: core/alg_frame/client_trainer.py:52 — a
+  stateful torch loop) becomes `client_update`: a pure function
+  (broadcast, shard, client_state, rng) -> (update, new_state, metrics) whose
+  inner SGD loop is `lax.scan` over batch indices, so the whole local epoch
+  compiles into one XLA program.
+- `ServerAggregator.aggregate()` (reference: core/alg_frame/server_aggregator.py:67)
+  becomes `server_update`: (ServerState, aggregated_update) -> ServerState.
+- Aggregation itself is declared, not executed, by the algorithm: LINEAR means
+  "weighted mean, psum-able over a mesh axis"; FULL means "needs every client
+  update materialized" (robust defenses like Krum). The round engine
+  (parallel/round.py) picks collectives accordingly.
+
+Lifecycle hooks (`on_before/after_local_training`, `on_before/on/after_
+aggregation` — reference: server_aggregator.py:42-83, client_trainer.py:32-59)
+are composable pytree transforms (core/hooks.py), so DP/security/compression
+stay plugins, not forks (SURVEY.md §7.3).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from ..ops import tree as tu
+
+Pytree = Any
+
+# Aggregation modes
+LINEAR = "linear"   # update aggregates as a sample-count-weighted mean (psum)
+FULL = "full"       # aggregator needs the full stacked update set (all_gather)
+
+
+@struct.dataclass
+class ServerState:
+    """Global state carried across rounds. `extra` holds algorithm-specific
+    state (SCAFFOLD's c, FedDyn's h, Mime's broadcast optimizer state...)."""
+    params: Pytree
+    opt_state: Any
+    round: jax.Array
+    extra: Any = None
+
+
+@struct.dataclass
+class ClientMetrics:
+    """Linear-aggregable training metrics (sums, not means)."""
+    loss_sum: jax.Array
+    correct: jax.Array
+    count: jax.Array
+
+
+def masked_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    """Cross-entropy over a padded batch. Returns (loss_mean, correct, count).
+    Padding rows (mask=0) contribute nothing; a fully-padded batch yields 0
+    loss and 0 gradient, so SPMD-padded clients train correctly."""
+    if logits.ndim == 3:  # sequence model: [B, T, V] vs y [B, T]
+        logits = logits.reshape(-1, logits.shape[-1])
+        y = y.reshape(-1)
+        mask = jnp.repeat(mask, logits.shape[0] // mask.shape[0])
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (ce * mask).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y) * mask).sum()
+    return loss, correct, mask.sum()
+
+
+def make_batch_indices(rng: jax.Array, shard_size: int, batch_size: int, epochs: int):
+    """Per-epoch permutations of a padded shard, reshaped to [epochs*nb, B].
+    Equivalent to the reference's shuffling DataLoader per local epoch
+    (reference: ml/trainer/my_model_trainer_classification.py:43)."""
+    bs = min(batch_size, shard_size)
+    nb = shard_size // bs
+    perms = jax.vmap(lambda r: jax.random.permutation(r, shard_size))(
+        jax.random.split(rng, epochs)
+    )
+    # truncate the tail when bs doesn't divide shard_size (user-supplied
+    # FedDatasets aren't necessarily padded to a batch multiple)
+    return perms[:, : nb * bs].reshape(epochs * nb, bs)
+
+
+def make_client_optimizer(name: str, lr: float, momentum: float = 0.0,
+                          weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """Client-side optimizer factory (reference: my_model_trainer_classification.py:30
+    builds torch SGD/Adam from args.client_optimizer)."""
+    txs = []
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    name = name.lower()
+    if name == "sgd":
+        txs.append(optax.sgd(lr, momentum=momentum if momentum else None))
+    elif name == "adam":
+        txs.append(optax.adam(lr))
+    elif name == "adamw":
+        return optax.adamw(lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown client_optimizer {name!r}")
+    return optax.chain(*txs)
+
+
+def local_sgd(
+    apply_fn: Callable,
+    params: Pytree,
+    shard: dict,                     # {"x": [S,...], "y": [S], "mask": [S]}
+    batch_idx: jax.Array,            # [num_steps, B] int32
+    opt: optax.GradientTransformation,
+    grad_correction: Optional[Callable[[Pytree, Pytree], Pytree]] = None,
+) -> tuple[Pytree, ClientMetrics, jax.Array]:
+    """The hot loop: lax.scan over batches; grads of the masked CE loss;
+    optional per-step gradient correction (FedProx prox term, SCAFFOLD control
+    variates, FedDyn linear terms — all are `g + f(params)` shapes).
+
+    Returns (final_params, summed_metrics, effective_steps) where
+    effective_steps counts batches containing >=1 real sample — FedNova's
+    tau_i under padding.
+    """
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch):
+        logits = apply_fn({"params": p}, batch["x"])
+        return masked_softmax_ce(logits, batch["y"], batch["mask"])
+
+    def step(carry, idx):
+        p, s = carry
+        batch = {k: v[idx] for k, v in shard.items()}
+        (loss, (correct, cnt)), grads = jax.value_and_grad(
+            lambda pp, b: (lambda l, c, n: (l, (c, n)))(*loss_fn(pp, b))
+        , has_aux=True)(p, batch)
+        if grad_correction is not None:
+            grads = grad_correction(grads, p)
+        updates, s = opt.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        nonempty = (cnt > 0).astype(jnp.float32)
+        return (p, s), (loss * cnt, correct, cnt, nonempty)
+
+    (params, _), (losses, corrects, counts, steps) = jax.lax.scan(
+        step, (params, opt_state), batch_idx
+    )
+    metrics = ClientMetrics(losses.sum(), corrects.sum(), counts.sum())
+    return params, metrics, steps.sum()
+
+
+@dataclasses.dataclass(frozen=True)
+class FedAlgorithm:
+    """The pluggable federated-optimizer contract (one instance per algorithm;
+    registered in core.registry.ALGORITHMS by name, matching the reference's
+    `federated_optimizer` config values)."""
+    name: str
+    server_init: Callable[[Pytree, Any], ServerState]
+    client_update: Callable[..., tuple[Pytree, Pytree, ClientMetrics]]
+    server_update: Callable[[ServerState, Pytree], ServerState]
+    # broadcast: what clients see. Default: current global params + extra.
+    broadcast: Callable[[ServerState], dict] = None  # type: ignore[assignment]
+    # per-client persistent state (stacked [num_clients, ...] by the engine)
+    client_state_init: Optional[Callable[[Pytree], Pytree]] = None
+    agg_mode: str = LINEAR
+
+    def __post_init__(self):
+        if self.broadcast is None:
+            object.__setattr__(
+                self, "broadcast",
+                lambda st: {"params": st.params, "extra": st.extra},
+            )
+
+
+def eval_step_fn(apply_fn: Callable):
+    """Batched, jittable eval over the global test set (reference:
+    `test_on_server_for_all_clients`, cross_silo/server/fedml_aggregator.py)."""
+
+    def eval_batches(params, x, y, mask):
+        def one(carry, batch):
+            loss, correct, cnt = masked_softmax_ce(
+                apply_fn({"params": params}, batch["x"]), batch["y"], batch["mask"]
+            )
+            return carry, (loss * cnt, correct, cnt)
+
+        _, (l, c, n) = jax.lax.scan(one, 0, {"x": x, "y": y, "mask": mask})
+        n_tot = jnp.maximum(n.sum(), 1.0)
+        return {"loss": l.sum() / n_tot, "acc": c.sum() / n_tot, "n": n.sum()}
+
+    return eval_batches

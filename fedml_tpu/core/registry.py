@@ -1,0 +1,48 @@
+"""Generic name->factory registries.
+
+Replace the reference's if/elif hubs (reference: python/fedml/model/model_hub.py:19-83,
+python/fedml/data/data_loader.py:262-525) with open registries so user code can
+plug in models/datasets/algorithms without forking the framework.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._items: Dict[str, T] = {}
+
+    def register(self, name: str) -> Callable[[T], T]:
+        def deco(obj: T) -> T:
+            key = name.lower()
+            if key in self._items:
+                raise KeyError(f"{self.kind} {name!r} already registered")
+            self._items[key] = obj
+            return obj
+
+        return deco
+
+    def get(self, name: str) -> T:
+        key = name.lower()
+        if key not in self._items:
+            raise KeyError(
+                f"unknown {self.kind} {name!r}; available: {sorted(self._items)}"
+            )
+        return self._items[key]
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._items
+
+    def names(self) -> list[str]:
+        return sorted(self._items)
+
+
+MODELS: Registry = Registry("model")
+DATASETS: Registry = Registry("dataset")
+ALGORITHMS: Registry = Registry("federated_optimizer")
+DEFENSES: Registry = Registry("defense")
+ATTACKS: Registry = Registry("attack")

@@ -1,0 +1,146 @@
+"""Model hub: (model_name, dataset) -> flax Module.
+
+TPU-native replacement for the reference model hub if-chain (reference:
+python/fedml/model/model_hub.py:19-83: lr, cnn, rnn, resnet18_gn, resnet56/20,
+mobilenet, efficientnet, vgg, ...). Norm layers are GroupNorm, never BatchNorm:
+federated averaging of BN running stats is ill-defined, which is exactly why the
+reference ships resnet18_gn (reference: model/cv/resnet_gn.py) for FL. GroupNorm
+also keeps the apply function state-free — params-only pytrees, the clean fit
+for functional aggregation.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import MODELS
+
+
+class LogisticRegression(nn.Module):
+    """reference: model/linear/lr.py — single dense layer over flattened input."""
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)
+
+
+class MLP(nn.Module):
+    num_classes: int
+    hidden: Sequence[int] = (256, 128)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CNN(nn.Module):
+    """FedAvg-paper 2-conv CNN (reference: model/cv/cnn.py CNN_DropOut for
+    femnist/mnist). Channels-last NHWC, MXU-friendly 3x3 convs."""
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: tuple = (1, 1)
+    groups: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        gn = lambda: nn.GroupNorm(num_groups=min(self.groups, self.filters))
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False)(x)
+        y = nn.relu(gn()(y))
+        y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+        y = gn()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides, use_bias=False)(x)
+            residual = gn()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1 with GroupNorm (reference: model/cv/resnet_gn.py resnet18_gn;
+    also covers resnet20/56 cifar variants via stage_sizes/filters)."""
+    num_classes: int
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    filters: int = 64
+    cifar_stem: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.cifar_stem:
+            x = nn.Conv(self.filters, (3, 3), use_bias=False)(x)
+        else:
+            x = nn.Conv(self.filters, (7, 7), (2, 2), use_bias=False)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(32, self.filters))(x))
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            f = self.filters * (2 ** i)
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = ResNetBlock(f, strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class CharRNN(nn.Module):
+    """LSTM LM for shakespeare/next-word-prediction tasks (reference:
+    model/nlp/rnn.py RNN_OriginalFedAvg). Input: int tokens [B, T]; output
+    logits [B, T, vocab]. The scan-over-time is lax.scan via nn.RNN."""
+    vocab_size: int
+    embed_dim: int = 8
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        return nn.Dense(self.vocab_size)(x)
+
+
+MODELS.register("lr")(lambda num_classes, **kw: LogisticRegression(num_classes))
+MODELS.register("mlp")(lambda num_classes, **kw: MLP(num_classes))
+MODELS.register("cnn")(lambda num_classes, **kw: CNN(num_classes))
+MODELS.register("resnet18")(lambda num_classes, **kw: ResNet(num_classes))
+MODELS.register("resnet18_gn")(lambda num_classes, **kw: ResNet(num_classes))
+MODELS.register("resnet20")(
+    lambda num_classes, **kw: ResNet(num_classes, stage_sizes=(3, 3, 3), filters=16)
+)
+MODELS.register("resnet56")(
+    lambda num_classes, **kw: ResNet(num_classes, stage_sizes=(9, 9, 9), filters=16)
+)
+MODELS.register("rnn")(lambda num_classes, **kw: CharRNN(vocab_size=num_classes))
+
+
+def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
+    """fedml.model.create equivalent (reference: model/model_hub.py:19)."""
+    return MODELS.get(model_name)(num_classes=num_classes, **kwargs)
+
+
+def init_params(module: nn.Module, input_shape: tuple, rng: jax.Array, dtype=jnp.float32):
+    dummy = (
+        jnp.zeros((1,) + tuple(input_shape), dtype=jnp.int32)
+        if isinstance(module, CharRNN)
+        else jnp.zeros((1,) + tuple(input_shape), dtype=dtype)
+    )
+    return module.init(rng, dummy)["params"]

@@ -1,0 +1,112 @@
+"""Pytree arithmetic for federated aggregation and update transforms.
+
+TPU-native replacement for the reference's per-engine, per-tensor Python
+aggregation loops (reference: python/fedml/ml/aggregator/agg_operator.py:34-226,
+which special-cases torch/tf/jax/mxnet and even hardcodes leaf names for JAX).
+Here every aggregation rule is a pure jnp pytree transform: it jits, vmaps over
+stacked client axes, and fuses into the round program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_map(f: Callable, *trees: Pytree) -> Pytree:
+    return jax.tree.map(f, *trees)
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(t: Pytree, s) -> Pytree:
+    return jax.tree.map(lambda x: x * s, t)
+
+def tree_zeros_like(t: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, t)
+
+
+def tree_dot(a: Pytree, b: Pytree) -> jax.Array:
+    leaves = jax.tree.leaves(jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b))
+    return jnp.sum(jnp.stack(leaves))
+
+
+def tree_sq_norm(t: Pytree) -> jax.Array:
+    leaves = jax.tree.leaves(jax.tree.map(lambda x: jnp.vdot(x, x), t))
+    return jnp.sum(jnp.stack(leaves))
+
+
+def tree_norm(t: Pytree) -> jax.Array:
+    return jnp.sqrt(tree_sq_norm(t))
+
+
+def tree_clip_by_global_norm(t: Pytree, max_norm) -> Pytree:
+    """Scale the whole pytree so its global L2 norm is at most max_norm."""
+    norm = tree_norm(t)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return tree_scale(t, scale)
+
+
+def tree_cast(t: Pytree, dtype) -> Pytree:
+    return jax.tree.map(lambda x: x.astype(dtype), t)
+
+
+def tree_stack(trees: list[Pytree]) -> Pytree:
+    """[tree, tree, ...] -> tree with leading stacked axis on every leaf."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_unstack(stacked: Pytree) -> list[Pytree]:
+    leaves, treedef = jax.tree.flatten(stacked)
+    n = leaves[0].shape[0]
+    return [jax.tree.unflatten(treedef, [leaf[i] for leaf in leaves]) for i in range(n)]
+
+
+def tree_index(stacked: Pytree, i) -> Pytree:
+    return jax.tree.map(lambda x: x[i], stacked)
+
+
+def tree_weighted_mean(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Weighted mean over the leading (client) axis of a stacked pytree.
+
+    This is FedAvg's merge (reference: agg_operator.py:34-56 applies
+    sample-count weights per key in a Python loop) as a single fused einsum
+    per leaf.
+    """
+    w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+
+    def mean_leaf(x):
+        wb = w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return jnp.sum(x * wb, axis=0)
+
+    return jax.tree.map(mean_leaf, stacked)
+
+
+def tree_flatten_to_vector(t: Pytree) -> tuple[jax.Array, Callable[[jax.Array], Pytree]]:
+    """Flatten a pytree to one 1-D vector; returns (vector, unflatten_fn).
+
+    Robust-aggregation defenses (Krum, median, ...) operate on flat update
+    vectors; this keeps them shape-agnostic.
+    """
+    leaves, treedef = jax.tree.flatten(t)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(jnp.size(l)) for l in leaves]
+    vec = jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
+
+    def unflatten(v: jax.Array) -> Pytree:
+        out, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            out.append(v[off : off + size].reshape(shape))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return vec, unflatten

@@ -1,0 +1,40 @@
+"""Device-mesh helpers.
+
+The TPU analog of the reference's process topologies: MPI ranks / NCCL process
+groups (reference: simulation/nccl/base_framework/common.py:130-146,
+cross_silo/client/process_group_manager.py:8) become named axes of one
+jax.sharding.Mesh. `clients` is the federated-parallel axis; hierarchical
+cross-silo adds a (`silos`, `intra`) 2-D mesh (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Optional[dict] = None, devices=None) -> Mesh:
+    """axes: ordered {name: size}; size -1 means 'all remaining devices'.
+    Default: 1-D mesh over all devices on axis `clients`."""
+    devices = devices if devices is not None else jax.devices()
+    axes = dict(axes or {"clients": len(devices)})
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {axes} needs {total} devices, have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def client_sharding(mesh: Mesh, axis: str = "clients") -> NamedSharding:
+    """Shard the leading (client) axis across the mesh; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

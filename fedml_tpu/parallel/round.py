@@ -1,0 +1,207 @@
+"""The federated round as one XLA program over a device mesh.
+
+This is the TPU-native core (BASELINE.json north star: `backend=XLA`). The
+reference runs a round as processes exchanging messages — broadcast params,
+per-process local training, reduce(SUM) of weight-premultiplied params
+(reference: simulation/nccl/base_framework/common.py:180-226,
+LocalAggregator.py:69-92). Here the whole round is a single jitted function:
+
+    gather(sampled shards) -> shard_map over `clients` mesh axis:
+        scan over this chip's clients (optionally chunked-vmap within the scan)
+        each client: lax.scan local SGD -> update
+        weight-premultiplied partial sums            (== LocalAggregator:79-81)
+    -> psum over `clients`                           (== dist.reduce(SUM))
+    -> server_update, replicated                     (== rank-0 aggregate)
+
+Broadcast is implicit (replicated sharding); there is no server process at all.
+More sampled clients than chips -> the per-chip scan sequentially simulates its
+assigned clients, exactly the fedavg_seq/NCCL-sim worker-sequential pattern
+(reference: simulation/mpi/fedavg_seq/, nccl/README.md:3-25).
+
+FULL-mode aggregators (robust defenses that need every client update
+materialized — Krum, median, ...) use all_gather instead of psum.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.algorithm import FULL, ClientMetrics, FedAlgorithm, ServerState
+from ..ops import tree as tu
+
+Pytree = Any
+
+
+def _localize(tree: Pytree, axis: str) -> Pytree:
+    """Convert replicated values to device-varying inside a shard_map body,
+    so gradients w.r.t. them stay per-device instead of auto-psum'd."""
+    if hasattr(jax.lax, "pcast"):  # jax >= 0.9
+        cast = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+    else:  # pragma: no cover
+        cast = lambda x: jax.lax.pvary(x, (axis,))
+    return jax.tree.map(lambda x: cast(x) if hasattr(x, "dtype") else x, tree)
+
+
+class RoundOutput(NamedTuple):
+    server_state: ServerState
+    client_states: Pytree          # full stacked [num_clients_total, ...] or None
+    metrics: dict                  # {"train_loss": ..., "train_acc": ..., "n": ...}
+
+
+def build_round_fn(
+    alg: FedAlgorithm,
+    mesh: Optional[Mesh] = None,
+    axis: str = "clients",
+    group_size: int = 1,
+    aggregate_full: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+) -> Callable:
+    """Build the jitted round function.
+
+    round_fn(server_state, full_client_states, data, ids, weights, rng)
+      -> RoundOutput
+    where data = {"x": [N, S, ...], "y": [N, S], "mask": [N, S]} (device-resident,
+    client-sharded when a mesh is given), ids = [m] sampled client indices
+    (host-driven sampling for reference parity — fedavg_api.py:127 seeds np by
+    round), weights = [m] aggregation weights.
+
+    group_size: clients vmapped together inside the per-chip scan (G-way
+    batching of client simulation; G=1 is the pure-sequential NCCL-sim shape).
+    postprocess_update: per-client update transform applied before aggregation
+    (compression, local DP, attacks — the on_after_local_training hook site,
+    reference: core/alg_frame/client_trainer.py:56-59).
+    aggregate_full: FULL-mode aggregation fn(stacked_updates, weights) -> agg
+    (robust defenses; forces all_gather path).
+    """
+    use_full = aggregate_full is not None or alg.agg_mode == FULL
+
+    def one_client(bcast, shard, cstate, rng, weight):
+        upd, new_state, met = alg.client_update(bcast, shard, cstate, rng)
+        if postprocess_update is not None:
+            upd = postprocess_update(upd, rng)
+        return upd, new_state, met
+
+    def run_clients(bcast, shards, cstates, rngs, weights):
+        """Scan over local clients (leading axis), G-way vmapped chunks.
+        Returns (stacked updates, new states, summed metrics)."""
+        m_local = shards["y"].shape[0]
+        g = max(1, min(group_size, m_local))
+        while m_local % g:  # largest divisor of m_local not exceeding group_size
+            g -= 1
+        n_groups = m_local // g
+
+        def body(_, inp):
+            sh, cs, rg, w = inp
+            upd, ns, met = jax.vmap(one_client, in_axes=(None, 0, 0, 0, 0))(
+                bcast, sh, cs, rg, w
+            )
+            # zero-weight clients are mesh-padding duplicates (simulator
+            # _pad_ids); keep them out of the reported training metrics
+            met = jax.tree.map(lambda a: a * (w > 0).astype(a.dtype), met)
+            return None, (upd, ns, met)
+
+        grouped = jax.tree.map(
+            lambda a: a.reshape((n_groups, g) + a.shape[1:]),
+            (shards, cstates, rngs, weights),
+        )
+        _, (upds, nstates, mets) = jax.lax.scan(body, None, grouped)
+        ungroup = lambda a: a.reshape((m_local,) + a.shape[2:])
+        return (
+            jax.tree.map(ungroup, upds),
+            jax.tree.map(ungroup, nstates),
+            jax.tree.map(ungroup, mets),
+        )
+
+    def finalize(server_state, agg, mets: ClientMetrics, new_states_full):
+        new_server = alg.server_update(server_state, agg)
+        n = jnp.maximum(mets.count, 1.0)
+        metrics = {
+            "train_loss": mets.loss_sum / n,
+            "train_acc": mets.correct / n,
+            "n_samples": mets.count,
+        }
+        return RoundOutput(new_server, new_states_full, metrics)
+
+    def round_body(server_state, full_cstates, data, ids, weights, rng):
+        bcast = alg.broadcast(server_state)
+        shards = {
+            "x": jnp.take(data["x"], ids, axis=0),
+            "y": jnp.take(data["y"], ids, axis=0),
+            "mask": jnp.take(data["mask"], ids, axis=0),
+        }
+        has_cstate = alg.client_state_init is not None
+        cstates = (
+            jax.tree.map(lambda a: jnp.take(a, ids, axis=0), full_cstates)
+            if has_cstate
+            else jnp.zeros((ids.shape[0],))
+        )
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
+
+        if mesh is None:
+            upds, nstates, mets = run_clients(bcast, shards, cstates, rngs, weights)
+            agg = (
+                aggregate_full(upds, weights)
+                if use_full
+                else tu.tree_weighted_mean(upds, weights)
+            )
+            summed = jax.tree.map(lambda a: a.sum(0), mets)
+        else:
+            spec_c, spec_r = P(axis), P()
+
+            @functools.partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
+                out_specs=(spec_r, spec_c, spec_r),
+            )
+            def block(bc, sh, cs, rg, w):
+                # Mark the replicated broadcast as device-varying before any
+                # differentiation: shard_map treats grads w.r.t. replicated
+                # values as global (auto-psum across the mesh), but local SGD
+                # needs per-client gradients. pcast/pvary localizes the copy.
+                bc = _localize(bc, axis)
+                upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
+                if use_full:
+                    gathered = jax.tree.map(
+                        lambda a: jax.lax.all_gather(a, axis, tiled=True), upds
+                    )
+                    w_all = jax.lax.all_gather(w, axis, tiled=True)
+                    agg = aggregate_full(gathered, w_all)
+                else:
+                    # weight-premultiplied local sum, then one psum — the
+                    # NCCL-sim reduce (common.py:197-207) as an XLA collective
+                    num = jax.tree.map(
+                        lambda a: jnp.sum(
+                            a * w.reshape((-1,) + (1,) * (a.ndim - 1)).astype(a.dtype),
+                            axis=0,
+                        ),
+                        upds,
+                    )
+                    num = jax.lax.psum(num, axis)
+                    den = jax.lax.psum(jnp.sum(w), axis)
+                    agg = jax.tree.map(lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
+                summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
+                return agg, nstates, summed
+
+            agg, nstates, summed = block(bcast, shards, cstates, rngs, weights)
+
+        if has_cstate:
+            full_cstates = jax.tree.map(
+                lambda full, new: full.at[ids].set(new), full_cstates, nstates
+            )
+        return finalize(server_state, agg, summed, full_cstates)
+
+    return jax.jit(round_body, donate_argnums=(0, 1))
+
+
+def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:
+    """device_put the stacked client arrays, sharded over the client axis."""
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in data.items()}
+    sh = NamedSharding(mesh, P(axis))
+    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in data.items()}

@@ -1,0 +1,170 @@
+"""Host-driven federated simulation loop.
+
+The TPU analog of the reference simulators (reference:
+simulation/simulator.py:26-238 SimulatorSingleProcess/MPI/NCCL and the
+canonical FedAvgAPI.train loop, simulation/sp/fedavg/fedavg_api.py:66-125).
+The host does only what cannot be traced: client sampling (seeded by round for
+reference parity — fedavg_api.py:127-135), eval cadence, logging, checkpoints.
+Everything else — local training of every sampled client, aggregation, the
+server step — is ONE jitted XLA program per round (parallel/round.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms import build_algorithm
+from ..config import BACKEND_XLA, Config
+from ..core.algorithm import eval_step_fn
+from ..data.fed_dataset import FedDataset
+from ..data import loader as data_loader
+from ..models import hub as model_hub
+from ..ops import tree as tu
+from ..parallel.mesh import make_mesh
+from ..parallel.round import build_round_fn, shard_fed_data
+from ..utils.events import recorder
+
+
+def _pad_test_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
+    n = x.shape[0]
+    nb = (n + batch_size - 1) // batch_size
+    pad = nb * batch_size - n
+    xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
+    yp = np.concatenate([y, np.zeros((pad,), y.dtype)]) if pad else y
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    rs = lambda a: a.reshape((nb, batch_size) + a.shape[1:])
+    return rs(xp), rs(yp), rs(mask)
+
+
+class Simulator:
+    """fedml.run_simulation equivalent for backend in {"sp", "xla"}.
+
+    backend="sp": single-device program (still jit, vmap over clients).
+    backend="xla": shard_map over the `clients` mesh axis — one FL client
+    (or a scanned set of clients) per chip.
+    """
+
+    def __init__(self, cfg: Config, dataset: Optional[FedDataset] = None,
+                 model=None, mesh=None):
+        self.cfg = cfg
+        t = cfg.train_args
+        self.dataset = dataset if dataset is not None else data_loader.load(cfg)
+        self.num_classes = self.dataset.num_classes
+
+        self.model = model if model is not None else model_hub.create(
+            cfg.model_args.model, self.num_classes
+        )
+        rng = jax.random.key(cfg.common_args.random_seed)
+        self.params = model_hub.init_params(
+            self.model, self.dataset.x_train.shape[2:], rng
+        )
+
+        use_mesh = cfg.comm_args.backend == BACKEND_XLA and len(jax.devices()) > 1
+        if mesh is not None:
+            self.mesh = mesh
+        elif use_mesh:
+            axes = cfg.device_args.mesh_shape or {"clients": len(jax.devices())}
+            self.mesh = make_mesh(axes)
+        else:
+            self.mesh = None
+
+        self.alg = build_algorithm(
+            t.federated_optimizer, self.model.apply, t,
+            t.client_num_in_total, t.client_num_per_round,
+        )
+        group = int(t.extra.get("clients_per_device_parallel", 1))
+        self.round_fn = build_round_fn(self.alg, self.mesh, group_size=group)
+
+        self.server_state = self.alg.server_init(self.params, cfg)
+        if self.alg.client_state_init is not None:
+            one = self.alg.client_state_init(self.params)
+            self.client_states = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (self.dataset.num_clients,) + a.shape).copy(),
+                one,
+            )
+        else:
+            self.client_states = jnp.zeros((self.dataset.num_clients,))
+
+        self.data = shard_fed_data(
+            {
+                "x": self.dataset.x_train,
+                "y": self.dataset.y_train,
+                "mask": self.dataset.mask_train,
+            },
+            self.mesh,
+        )
+        self.counts = jnp.asarray(self.dataset.counts, dtype=jnp.float32)
+
+        xb, yb, mb = _pad_test_batches(
+            self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
+        )
+        self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
+        self._eval = jax.jit(eval_step_fn(self.model.apply))
+        self.history: list[dict] = []
+
+    # reference parity: np seeded by round index (fedavg_api.py:127-135)
+    def sample_clients(self, round_idx: int) -> np.ndarray:
+        t = self.cfg.train_args
+        n, m = self.dataset.num_clients, t.client_num_per_round
+        if n == m:
+            return np.arange(m, dtype=np.int32)
+        np.random.seed(round_idx)
+        return np.sort(np.random.choice(range(n), m, replace=False)).astype(np.int32)
+
+    def _pad_ids(self, ids: np.ndarray):
+        """Pad sampled ids to a multiple of the mesh size with zero-weight
+        duplicates so shard_map shapes stay static."""
+        weights = np.asarray(self.counts)[ids].astype(np.float32)
+        if self.mesh is None:
+            return ids, weights
+        d = self.mesh.devices.size
+        pad = (-len(ids)) % d
+        if pad:
+            # pad with a duplicate of an already-sampled client (weight 0):
+            # its recompute is identical, so the client-state scatter-back is a
+            # harmless rewrite — padding with id 0 would corrupt client 0's
+            # persistent state (SCAFFOLD c_i / FedDyn h_i) on unsampled rounds
+            ids = np.concatenate([ids, np.full(pad, ids[0], np.int32)])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        return ids, weights
+
+    def run_round(self, round_idx: int) -> dict:
+        ids, weights = self._pad_ids(self.sample_clients(round_idx))
+        rng = jax.random.fold_in(
+            jax.random.key(self.cfg.common_args.random_seed), round_idx
+        )
+        with recorder.span("train", round=round_idx):
+            out = self.round_fn(
+                self.server_state, self.client_states, self.data,
+                jnp.asarray(ids), jnp.asarray(weights), rng,
+            )
+            metrics = jax.tree.map(float, jax.device_get(out.metrics))
+        self.server_state = out.server_state
+        self.client_states = out.client_states
+        return metrics
+
+    def evaluate(self) -> dict:
+        with recorder.span("eval"):
+            params = self.server_state.params
+            m = jax.device_get(self._eval(params, *self._test))
+        return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+
+    def run(self, num_rounds: Optional[int] = None) -> list[dict]:
+        t, v = self.cfg.train_args, self.cfg.validation_args
+        rounds = num_rounds if num_rounds is not None else t.comm_round
+        for r in range(rounds):
+            row = {"round": r, **self.run_round(r)}
+            if v.frequency_of_the_test and (
+                r % v.frequency_of_the_test == 0 or r == rounds - 1
+            ):
+                row.update(self.evaluate())
+            recorder.log(row)
+            self.history.append(row)
+        return self.history
+
+
+def run_simulation(cfg: Config, dataset=None, model=None) -> list[dict]:
+    return Simulator(cfg, dataset, model).run()

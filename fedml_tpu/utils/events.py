@@ -1,0 +1,80 @@
+"""Span-event tracing + metrics sink.
+
+Keeps the reference's span-event API shape — named phases wrapped in
+started/ended pairs (reference: core/mlops/mlops_profiler_event.py:74-121,
+used as mlops.event("train"/"agg"/"comm_c2s", event_started=...) at
+simulation/sp/fedavg/fedavg_api.py:98-109) — but local-first: events go to an
+in-process recorder and optionally to `jax.profiler` trace annotations, not to
+an MQTT cloud. Sinks are pluggable for wandb/file export.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger("fedml_tpu")
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class EventRecorder:
+    """Process-wide event/metric recorder (cheap; always on)."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self.metrics: list[dict] = []
+        self.sinks: list[Callable[[str, dict], None]] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta):
+        try:
+            import jax.profiler as jp
+            ctx = jp.TraceAnnotation(name)
+        except Exception:  # pragma: no cover
+            ctx = contextlib.nullcontext()
+        s = Span(name, time.perf_counter(), meta=meta)
+        try:
+            with ctx:
+                yield s
+        finally:
+            s.end = time.perf_counter()
+            self.spans.append(s)
+            for sink in self.sinks:
+                sink("span", {"name": name, "duration": s.duration, **meta})
+
+    def log(self, metrics: dict):
+        self.metrics.append(metrics)
+        for sink in self.sinks:
+            sink("metrics", metrics)
+
+    def summary(self) -> dict:
+        out: dict = {}
+        for s in self.spans:
+            agg = out.setdefault(s.name, {"count": 0, "total_s": 0.0})
+            agg["count"] += 1
+            agg["total_s"] += s.duration
+        return out
+
+    def dump(self, path: str):
+        with open(path, "w") as f:
+            for s in self.spans:
+                f.write(json.dumps({"span": s.name, "dur": s.duration, **s.meta}) + "\n")
+            for m in self.metrics:
+                f.write(json.dumps({"metrics": m}) + "\n")
+
+
+recorder = EventRecorder()

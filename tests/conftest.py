@@ -1,0 +1,25 @@
+"""Test conftest: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's "multi-node without a cluster" CI strategy
+(reference: python/tests/cross-silo/run_cross_silo.sh:1-28 fakes multi-node with
+multi-process on one box); here we fake a TPU pod with
+--xla_force_host_platform_device_count on CPU.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize registers the remote-TPU backend at interpreter start
+# and overrides JAX_PLATFORMS; force CPU after import (before first backend use).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
