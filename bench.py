@@ -1,0 +1,155 @@
+"""Benchmark: FedAvg rounds/sec, 100 clients, ResNet-18-GN on CIFAR-10-shaped data.
+
+The reference's headline workload (BASELINE.json: "FedAvg rounds/sec @100
+clients (CIFAR-10 ResNet-18)"). The reference publishes no in-tree numbers
+(BASELINE.md), so vs_baseline is measured against a faithful torch-CPU
+re-creation of the reference's per-client loop (simulation/sp/fedavg) run on a
+subsample of clients and linearly extrapolated — the reference itself is
+CUDA/CPU torch; this container has no GPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+NUM_CLIENTS = 100
+CLIENTS_PER_ROUND = 100
+SHARD = 96          # samples per client
+BATCH = 32
+EPOCHS = 1
+MEASURE_ROUNDS = 5
+
+
+def bench_tpu() -> float:
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "cifar10"},
+        "model_args": {"model": "resnet18_gn"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": NUM_CLIENTS,
+            "client_num_per_round": CLIENTS_PER_ROUND,
+            "comm_round": MEASURE_ROUNDS,
+            "epochs": EPOCHS,
+            "batch_size": BATCH,
+            "learning_rate": 0.05,
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": "xla" if len(jax.devices()) > 1 else "sp"},
+    })
+    cfg.data_args.extra["synthetic_samples_per_client"] = SHARD
+    sim = Simulator(cfg)
+    sim.run_round(0)  # compile
+    t0 = time.perf_counter()
+    for r in range(1, MEASURE_ROUNDS + 1):
+        sim.run_round(r)
+    dt = time.perf_counter() - t0
+    return MEASURE_ROUNDS / dt
+
+
+def bench_torch_baseline(n_clients_sub: int = 4) -> float:
+    """Reference-equivalent loop: per-client torch SGD over the same model
+    size/batch count, sequential like simulation/sp/fedavg/fedavg_api.py:87,
+    per-tensor python aggregation like :144-159. Measured on a subsample and
+    scaled to CLIENTS_PER_ROUND."""
+    import copy
+
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+    torch.set_num_threads(os.cpu_count() or 8)
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.g1 = nn.GroupNorm(min(32, cout), cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.g2 = nn.GroupNorm(min(32, cout), cout)
+            self.short = (
+                nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.GroupNorm(min(32, cout), cout),
+                )
+                if (stride != 1 or cin != cout)
+                else nn.Identity()
+            )
+
+        def forward(self, x):
+            y = F.relu(self.g1(self.c1(x)))
+            y = self.g2(self.c2(y))
+            return F.relu(y + self.short(x))
+
+    class ResNet18GN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Sequential(
+                nn.Conv2d(3, 64, 3, 1, 1, bias=False), nn.GroupNorm(32, 64), nn.ReLU()
+            )
+            layers, cin = [], 64
+            for i, n in enumerate([2, 2, 2, 2]):
+                cout = 64 * (2 ** i)
+                for j in range(n):
+                    layers.append(Block(cin, cout, 2 if (i > 0 and j == 0) else 1))
+                    cin = cout
+            self.body = nn.Sequential(*layers)
+            self.head = nn.Linear(512, 10)
+
+        def forward(self, x):
+            x = self.body(self.stem(x))
+            return self.head(x.mean(dim=(2, 3)))
+
+    model = ResNet18GN()
+    w_global = copy.deepcopy(model.state_dict())
+    rng = np.random.RandomState(0)
+    xs = torch.tensor(rng.randn(SHARD, 3, 32, 32).astype(np.float32))
+    ys = torch.tensor(rng.randint(0, 10, SHARD))
+
+    t0 = time.perf_counter()
+    w_locals = []
+    for _ in range(n_clients_sub):
+        model.load_state_dict(copy.deepcopy(w_global))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        for _e in range(EPOCHS):
+            for b in range(SHARD // BATCH):
+                xb = xs[b * BATCH : (b + 1) * BATCH]
+                yb = ys[b * BATCH : (b + 1) * BATCH]
+                opt.zero_grad()
+                F.cross_entropy(model(xb), yb).backward()
+                opt.step()
+        w_locals.append((SHARD, copy.deepcopy(model.state_dict())))
+    # reference-style per-key python aggregation (fedavg_api.py:144-159)
+    agg = copy.deepcopy(w_locals[0][1])
+    total = sum(n for n, _ in w_locals)
+    for k in agg:
+        agg[k] = sum(w[k] * (n / total) for n, w in w_locals)
+    dt = time.perf_counter() - t0
+    round_time_full = dt * (CLIENTS_PER_ROUND / n_clients_sub)
+    return 1.0 / round_time_full
+
+
+def main():
+    quick = "--quick" in sys.argv
+    tpu_rps = bench_tpu()
+    base_rps = bench_torch_baseline(2 if quick else 4)
+    print(json.dumps({
+        "metric": "fedavg_rounds_per_sec_100clients_resnet18_cifar10",
+        "value": round(tpu_rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(tpu_rps / base_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
