@@ -1,0 +1,138 @@
+"""Differential privacy plugin — pure pytree transforms on the round program.
+
+TPU-native replacement for the reference's singleton + torch-OrderedDict
+frames (reference: core/dp/fedml_differential_privacy.py:13-100; frames
+core/dp/frames/{ldp,cdp,NbAFL,dp_clip}.py). The reference notes its DP does
+NOT support jax (fedml_differential_privacy.py:58-66 raises for tf/jax/mxnet);
+here DP is jax-first:
+
+- LDP  — clip + noise each client update *inside* the round program (the
+  `postprocess_update` hook of parallel/round.py, the same site as the
+  reference's `on_after_local_training`, core/alg_frame/client_trainer.py:56).
+- CDP  — clip each client update, add calibrated noise once to the aggregate
+  (`postprocess_agg` hook; reference: frames/cdp.py global noise, wired at
+  server_aggregator.py:45,79).
+- NbAFL — per-coordinate clip + local noise + round-dependent global noise
+  (reference: frames/NbAFL.py:14-60, paper IEEE 9069945).
+- dp_clip — clipping only, no noise (reference: frames/dp_clip.py).
+
+Budget tracking via the RDP accountant (accountant.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config, DPArgs
+from ..ops import tree as tu
+from .accountant import RDPAccountant
+from .mechanisms import (
+    add_gaussian_noise,
+    add_laplace_noise,
+    gaussian_sigma,
+    laplace_scale,
+    make_mechanism,
+)
+
+Pytree = Any
+
+LDP = "ldp"
+CDP = "cdp"
+NBAFL = "nbafl"
+DP_CLIP = "dp_clip"
+
+
+def _coord_clip(tree: Pytree, c: float) -> Pytree:
+    """Per-coordinate clip to [-c, c] by rescaling |x|>c coords (reference:
+    NbAFL.py:42-46 divides by max(1, |w|/C) elementwise)."""
+    return jax.tree.map(lambda x: x / jnp.maximum(1.0, jnp.abs(x) / c), tree)
+
+
+class FedDP:
+    """Config-driven DP pipeline; attach via `client_transform` /
+    `server_transform` (the reference's add_local_noise / add_global_noise
+    split, fedml_differential_privacy.py:73-88)."""
+
+    def __init__(self, d: DPArgs, client_num_per_round: int,
+                 client_num_in_total: int, comm_round: int):
+        self.args = d
+        self.solution = (d.dp_solution_type or LDP).lower()
+        self.m = client_num_per_round
+        self.n = client_num_in_total
+        self.T = comm_round
+        self.enabled = bool(d.enable_dp)
+        self.accountant: Optional[RDPAccountant] = None
+        if not self.enabled:
+            return
+        if d.mechanism_type.lower() == "gaussian":
+            self._sigma = gaussian_sigma(d.epsilon, d.delta, d.sensitivity)
+            self._noise = lambda rng, t, s: add_gaussian_noise(rng, t, s)
+            q = min(1.0, self.m / max(self.n, 1))
+            self.accountant = RDPAccountant(
+                noise_multiplier=self._sigma / max(d.sensitivity, 1e-12),
+                sampling_rate=q, target_delta=d.delta,
+            )
+        else:
+            self._sigma = laplace_scale(d.epsilon, d.sensitivity)
+            self._noise = lambda rng, t, s: add_laplace_noise(rng, t, s)
+
+    # ---------------------------------------------------------------- hooks
+    def client_transform(self) -> Optional[Callable[[Pytree, jax.Array], Pytree]]:
+        """Per-client update transform, traced into the round program."""
+        if not self.enabled:
+            return None
+        d = self.args
+        if self.solution == LDP:
+            def f(upd, rng):
+                upd = tu.tree_clip_by_global_norm(upd, d.clipping_norm)
+                return self._noise(rng, upd, self._sigma)
+            return f
+        if self.solution == NBAFL:
+            def f(upd, rng):
+                upd = _coord_clip(upd, d.clipping_norm)
+                return self._noise(rng, upd, self._sigma)
+            return f
+        if self.solution == DP_CLIP:
+            return lambda upd, rng: tu.tree_clip_by_global_norm(upd, d.clipping_norm)
+        if self.solution == CDP:
+            # CDP clips locally, noises globally (frames/cdp.py)
+            return lambda upd, rng: tu.tree_clip_by_global_norm(upd, d.clipping_norm)
+        raise ValueError(f"unknown dp_solution_type {self.solution!r}")
+
+    def server_transform(self) -> Optional[Callable[[Pytree, jax.Array], Pytree]]:
+        """Aggregate transform (global noise), traced into the round program."""
+        if not self.enabled:
+            return None
+        d = self.args
+        if self.solution == CDP:
+            # sensitivity of the weighted mean of norm-C updates is C/m
+            sigma = self._sigma * d.clipping_norm / max(self.m, 1)
+            return lambda agg, rng: self._noise(rng, agg, sigma)
+        if self.solution == NBAFL:
+            # NbAFL.py:48-56: extra down-link noise only when T > sqrt(N)*L
+            if self.T > np.sqrt(self.n) * self.m:
+                c_small = np.sqrt(2 * np.log(1.25 / d.delta))
+                scale_d = (
+                    2 * c_small * d.clipping_norm
+                    * np.sqrt(self.T**2 - self.m**2 * self.n)
+                    / (max(self.n, 1) * d.epsilon)
+                ) / max(self.m, 1)
+                return lambda agg, rng: self._noise(rng, agg, float(scale_d))
+            return None
+        return None
+
+    def step_round(self) -> None:
+        if self.accountant is not None:
+            self.accountant.step()
+
+    def get_epsilon(self) -> float:
+        return self.accountant.get_epsilon() if self.accountant else float("nan")
+
+
+def from_config(cfg: Config) -> FedDP:
+    t = cfg.train_args
+    return FedDP(cfg.dp_args, t.client_num_per_round, t.client_num_in_total,
+                 t.comm_round)

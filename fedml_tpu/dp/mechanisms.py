@@ -1,0 +1,66 @@
+"""DP noise mechanisms as pure jnp pytree transforms.
+
+Replaces the reference's torch mechanism classes (reference:
+core/dp/mechanisms/{gaussian,laplace}.py — `Gaussian.compute_noise`
+gaussian.py:29, scale formula gaussian.py:17-21). The classic analytic
+calibration sigma = sqrt(2 ln(1.25/delta)) * sensitivity / epsilon is kept
+(valid for epsilon <= 1, same domain check as gaussian.py:12-15).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def gaussian_sigma(epsilon: float, delta: float, sensitivity: float = 1.0) -> float:
+    """Analytic Gaussian calibration (reference: gaussian.py:17-21)."""
+    if epsilon <= 0 or delta <= 0:
+        raise ValueError("epsilon and delta must be positive")
+    if epsilon > 1.0:
+        raise ValueError("analytic Gaussian calibration requires epsilon <= 1")
+    return math.sqrt(2 * math.log(1.25 / delta)) * sensitivity / epsilon
+
+
+def laplace_scale(epsilon: float, sensitivity: float = 1.0) -> float:
+    """Laplace mechanism b = sensitivity/epsilon (reference: laplace.py)."""
+    if epsilon <= 0:
+        raise ValueError("epsilon must be positive")
+    return sensitivity / epsilon
+
+
+def _tree_noise(rng: jax.Array, tree: Pytree, sample) -> Pytree:
+    leaves, treedef = jax.tree.flatten(tree)
+    rngs = jax.random.split(rng, len(leaves))
+    return jax.tree.unflatten(
+        treedef, [x + sample(r, x) for r, x in zip(rngs, leaves)]
+    )
+
+
+def add_gaussian_noise(rng: jax.Array, tree: Pytree, sigma: float) -> Pytree:
+    return _tree_noise(
+        rng, tree, lambda r, x: (sigma * jax.random.normal(r, x.shape)).astype(x.dtype)
+    )
+
+
+def add_laplace_noise(rng: jax.Array, tree: Pytree, scale: float) -> Pytree:
+    return _tree_noise(
+        rng, tree, lambda r, x: (scale * jax.random.laplace(r, x.shape)).astype(x.dtype)
+    )
+
+
+def make_mechanism(name: str, epsilon: float, delta: float, sensitivity: float):
+    """name -> (rng, tree) -> noised tree (reference: mechanisms/dp_mechanism.py
+    dispatch)."""
+    name = (name or "gaussian").lower()
+    if name == "gaussian":
+        sigma = gaussian_sigma(epsilon, delta, sensitivity)
+        return lambda rng, tree: add_gaussian_noise(rng, tree, sigma)
+    if name == "laplace":
+        b = laplace_scale(epsilon, sensitivity)
+        return lambda rng, tree: add_laplace_noise(rng, tree, b)
+    raise ValueError(f"unknown DP mechanism {name!r}")

@@ -51,6 +51,7 @@ class RoundOutput(NamedTuple):
     server_state: ServerState
     client_states: Pytree          # full stacked [num_clients_total, ...] or None
     metrics: dict                  # {"train_loss": ..., "train_acc": ..., "n": ...}
+    hook_state: Pytree = None      # defense/plugin state threaded across rounds
 
 
 def build_round_fn(
@@ -58,13 +59,15 @@ def build_round_fn(
     mesh: Optional[Mesh] = None,
     axis: str = "clients",
     group_size: int = 1,
-    aggregate_full: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    aggregate_full: Optional[Callable[[Pytree, jax.Array, dict], tuple]] = None,
     postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
+    num_real_clients: Optional[int] = None,
 ) -> Callable:
     """Build the jitted round function.
 
-    round_fn(server_state, full_client_states, data, ids, weights, rng)
-      -> RoundOutput
+    round_fn(server_state, full_client_states, data, ids, weights, rng,
+             hook_state) -> RoundOutput
     where data = {"x": [N, S, ...], "y": [N, S], "mask": [N, S]} (device-resident,
     client-sharded when a mesh is given), ids = [m] sampled client indices
     (host-driven sampling for reference parity — fedavg_api.py:127 seeds np by
@@ -75,10 +78,27 @@ def build_round_fn(
     postprocess_update: per-client update transform applied before aggregation
     (compression, local DP, attacks — the on_after_local_training hook site,
     reference: core/alg_frame/client_trainer.py:56-59).
-    aggregate_full: FULL-mode aggregation fn(stacked_updates, weights) -> agg
-    (robust defenses; forces all_gather path).
+    aggregate_full: FULL-mode aggregation fn(stacked_updates, weights, ctx)
+    -> (agg, new_hook_state) — robust defenses/attacks that need every client
+    update materialized (forces the all_gather path). ctx =
+    {"rng", "ids", "state", "params"} (the on_before/on_aggregation hook
+    sites, reference: core/alg_frame/server_aggregator.py:42-76).
+    postprocess_agg: fn(agg, ctx) -> agg applied to the aggregate before the
+    server update (central DP noise, SLSGD/CRFL post-processing — the
+    on_after_aggregation site, server_aggregator.py:79-83).
+    num_real_clients: the number of genuinely sampled clients. When the
+    simulator pads ids to a mesh multiple with zero-weight duplicates
+    (simulator._pad_ids), FULL-mode hooks must not see the duplicate rows —
+    unweighted statistics (krum distances, medians, foolsgold history) would
+    be silently biased by them; the engine slices U/weights/ids back to the
+    real prefix before invoking the hook.
     """
     use_full = aggregate_full is not None or alg.agg_mode == FULL
+    if use_full and aggregate_full is None:
+        # algorithm declared FULL aggregation but no hook was supplied:
+        # default to the weighted mean over the materialized update set
+        def aggregate_full(stacked, w, ctx):
+            return tu.tree_weighted_mean(stacked, w), ctx["state"]
 
     def one_client(bcast, shard, cstate, rng, weight):
         upd, new_state, met = alg.client_update(bcast, shard, cstate, rng)
@@ -117,7 +137,7 @@ def build_round_fn(
             jax.tree.map(ungroup, mets),
         )
 
-    def finalize(server_state, agg, mets: ClientMetrics, new_states_full):
+    def finalize(server_state, agg, mets: ClientMetrics, new_states_full, hook_state):
         new_server = alg.server_update(server_state, agg)
         n = jnp.maximum(mets.count, 1.0)
         metrics = {
@@ -125,9 +145,9 @@ def build_round_fn(
             "train_acc": mets.correct / n,
             "n_samples": mets.count,
         }
-        return RoundOutput(new_server, new_states_full, metrics)
+        return RoundOutput(new_server, new_states_full, metrics, hook_state)
 
-    def round_body(server_state, full_cstates, data, ids, weights, rng):
+    def round_body(server_state, full_cstates, data, ids, weights, rng, hook_state):
         bcast = alg.broadcast(server_state)
         shards = {
             "x": jnp.take(data["x"], ids, axis=0),
@@ -141,15 +161,49 @@ def build_round_fn(
             else jnp.zeros((ids.shape[0],))
         )
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
+        agg_rng = jax.random.fold_in(rng, 0x5EC)
+        ctx = {"rng": agg_rng, "ids": ids, "state": hook_state,
+               "params": server_state.params}
+
+        def call_full(upds, w):
+            mr = num_real_clients
+            if mr is not None and mr < ids.shape[0]:
+                upds = jax.tree.map(lambda a: a[:mr], upds)
+                w = w[:mr]
+                cx = {**ctx, "ids": ids[:mr]}
+            else:
+                cx = ctx
+            return aggregate_full(upds, w, cx)
 
         if mesh is None:
             upds, nstates, mets = run_clients(bcast, shards, cstates, rngs, weights)
-            agg = (
-                aggregate_full(upds, weights)
-                if use_full
-                else tu.tree_weighted_mean(upds, weights)
-            )
+            if use_full:
+                agg, hook_state = call_full(upds, weights)
+            else:
+                agg = tu.tree_weighted_mean(upds, weights)
             summed = jax.tree.map(lambda a: a.sum(0), mets)
+        elif use_full:
+            spec_c, spec_r = P(axis), P()
+
+            @functools.partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
+                out_specs=(spec_c, spec_c, spec_r),
+            )
+            def block_full(bc, sh, cs, rg, w):
+                bc = _localize(bc, axis)
+                upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
+                summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
+                return upds, nstates, summed
+
+            # stacked updates come back client-sharded; the defense/attack
+            # pipeline runs at the jit level, where GSPMD inserts whatever
+            # collectives its ops need (gram matmuls for pairwise distances
+            # ride the ICI all-gather) — no manual all_gather, and the result
+            # is provably replicated for the server update.
+            upds, nstates, summed = block_full(bcast, shards, cstates, rngs, weights)
+            agg, hook_state = call_full(upds, weights)
         else:
             spec_c, spec_r = P(axis), P()
 
@@ -166,35 +220,30 @@ def build_round_fn(
                 # needs per-client gradients. pcast/pvary localizes the copy.
                 bc = _localize(bc, axis)
                 upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
-                if use_full:
-                    gathered = jax.tree.map(
-                        lambda a: jax.lax.all_gather(a, axis, tiled=True), upds
-                    )
-                    w_all = jax.lax.all_gather(w, axis, tiled=True)
-                    agg = aggregate_full(gathered, w_all)
-                else:
-                    # weight-premultiplied local sum, then one psum — the
-                    # NCCL-sim reduce (common.py:197-207) as an XLA collective
-                    num = jax.tree.map(
-                        lambda a: jnp.sum(
-                            a * w.reshape((-1,) + (1,) * (a.ndim - 1)).astype(a.dtype),
-                            axis=0,
-                        ),
-                        upds,
-                    )
-                    num = jax.lax.psum(num, axis)
-                    den = jax.lax.psum(jnp.sum(w), axis)
-                    agg = jax.tree.map(lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
+                # weight-premultiplied local sum, then one psum — the
+                # NCCL-sim reduce (common.py:197-207) as an XLA collective
+                num = jax.tree.map(
+                    lambda a: jnp.sum(
+                        a * w.reshape((-1,) + (1,) * (a.ndim - 1)).astype(a.dtype),
+                        axis=0,
+                    ),
+                    upds,
+                )
+                num = jax.lax.psum(num, axis)
+                den = jax.lax.psum(jnp.sum(w), axis)
+                agg = jax.tree.map(lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
                 summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
                 return agg, nstates, summed
 
             agg, nstates, summed = block(bcast, shards, cstates, rngs, weights)
 
+        if postprocess_agg is not None:
+            agg = postprocess_agg(agg, ctx)
         if has_cstate:
             full_cstates = jax.tree.map(
                 lambda full, new: full.at[ids].set(new), full_cstates, nstates
             )
-        return finalize(server_state, agg, summed, full_cstates)
+        return finalize(server_state, agg, summed, full_cstates, hook_state)
 
     return jax.jit(round_body, donate_argnums=(0, 1))
 

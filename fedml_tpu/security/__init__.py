@@ -1,0 +1,288 @@
+"""Security plugin dispatchers — config-driven attacker/defender wiring.
+
+TPU-native replacement for the reference singletons `FedMLAttacker`
+(reference: core/security/fedml_attacker.py:14-110) and `FedMLDefender`
+(fedml_defender.py:40-120). The reference intercepts the server's
+List[Tuple[weight, OrderedDict]]; here both plug into the round program as the
+`aggregate_full(stacked, weights, ctx) -> (agg, hook_state)` hook
+(parallel/round.py), operating on the flat update matrix U: [m, D].
+
+Composition order inside the hook (mirrors the reference lifecycle,
+core/alg_frame/server_aggregator.py:42-83):
+    attack_model (poison U)  →  defense reweight/select  →  robust aggregate
+    →  postprocess_agg (SLSGD/CRFL/weak-DP noise).
+
+Stateful defenses (FoolsGold history, cross-round memory, lazy-worker replay)
+keep their state in `hook_state`, a pytree threaded through the jitted round —
+no host round-trips (the reference mutates python dicts on the server).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config, SecurityArgs
+from . import attacks as atk
+from . import defenses as dfs
+
+Pytree = Any
+
+# name constants (reference: core/security/constants.py:1-23)
+DEFENSES = (
+    "krum", "multikrum", "bulyan", "wise_median", "trimmed_mean", "geo_median",
+    "rfa", "cclip", "norm_diff_clipping", "diff_clipping", "weak_dp",
+    "robust_learning_rate", "slsgd", "crfl", "foolsgold", "3sigma",
+    "3sigma_geo", "3sigma_foolsgold", "cross_round", "residual_reweight",
+    "outlier_detection", "wbc", "soteria",
+)
+ATTACKS = ("byzantine", "label_flipping", "backdoor", "model_replacement",
+           "edge_case_backdoor", "lazy_worker", "dlg", "invert_gradient",
+           "revealing_labels")
+
+
+def _flat_dim(params: Pytree) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
+
+
+class FedAttacker:
+    """Model/data poisoning injector for robustness testing (reference:
+    fedml_attacker.py:29-41 reads attack_type + spec)."""
+
+    def __init__(self, s: SecurityArgs, client_num_per_round: int):
+        self.enabled = bool(s.enable_attack)
+        self.type = (s.attack_type or "").lower()
+        self.spec = dict(s.attack_spec)
+        self.m = client_num_per_round
+
+    def malicious_mask(self, m: int) -> np.ndarray:
+        """First `byzantine_client_num` of the m sampled slots are malicious
+        (the reference samples random slots per round, byzantine_attack.py:25;
+        deterministic slots keep tests reproducible — sampling is already
+        random over clients). m is taken from the update matrix actually
+        presented to the hook, so mesh padding can never desync the mask."""
+        n_mal = int(self.spec.get("byzantine_client_num", 1))
+        mask = np.zeros(m, bool)
+        mask[: min(n_mal, m)] = True
+        return mask
+
+    def poison_updates(self, U: jax.Array, w: jax.Array, ctx: dict,
+                       state: Pytree) -> tuple[jax.Array, Pytree]:
+        """The attack_model hook on the flat stacked updates."""
+        if not self.enabled:
+            return U, state
+        mal = jnp.asarray(self.malicious_mask(U.shape[0]))
+        rng = jax.random.fold_in(ctx["rng"], 0xA77)
+        if self.type == "byzantine":
+            mode = self.spec.get("attack_mode", "random")
+            return atk.byzantine_attack(U, mal, rng, mode), state
+        if self.type in ("model_replacement", "backdoor"):
+            scale = float(self.spec.get("scale_factor", self.m))
+            return atk.model_replacement_attack(U, mal, scale), state
+        if self.type == "lazy_worker":
+            prev = state if state is not None else jnp.zeros_like(U)
+            out = atk.lazy_worker_attack(U, mal, prev)
+            return out, U  # remember this round's honest updates
+        return U, state  # data-level attacks don't touch updates
+
+    def init_state(self, m: int, dim: int) -> Pytree:
+        if self.enabled and self.type == "lazy_worker":
+            return jnp.zeros((m, dim), jnp.float32)
+        return None
+
+    def poison_dataset(self, data: dict, num_classes: int) -> dict:
+        """Data-poisoning hook applied host-side before device upload
+        (reference: poison_data, fedml_attacker.py:98, called from
+        client_trainer.py:32-38)."""
+        if not self.enabled:
+            return data
+        cids = list(self.spec.get("poisoned_client_ids", [0]))
+        if self.type == "label_flipping":
+            return atk.poison_clients_data(
+                data, cids,
+                lambda x, y: (x, atk.label_flip(
+                    y, num_classes,
+                    self.spec.get("original_class"),
+                    self.spec.get("target_class"),
+                )),
+            )
+        if self.type in ("backdoor", "edge_case_backdoor"):
+            target = int(self.spec.get("target_class", 0))
+            return atk.poison_clients_data(
+                data, cids, lambda x, y: atk.backdoor_trigger(x, y, target)
+            )
+        return data
+
+
+class FedDefender:
+    """Robust-aggregation dispatcher (reference: fedml_defender.py:55-90 maps
+    defense_type -> defense object; here -> a pure aggregate/reweight fn)."""
+
+    def __init__(self, s: SecurityArgs, num_clients_total: int):
+        self.enabled = bool(s.enable_defense)
+        self.type = (s.defense_type or "").lower()
+        self.spec = dict(s.defense_spec)
+        self.n_total = num_clients_total
+        if self.enabled and self.type not in DEFENSES:
+            raise ValueError(f"unknown defense {self.type!r}; one of {DEFENSES}")
+
+    @property
+    def stateful(self) -> bool:
+        return self.type in ("foolsgold", "3sigma_foolsgold", "cross_round")
+
+    def init_state(self, dim: int) -> Pytree:
+        """FoolsGold/cross-round keep per-global-client history [N, D]."""
+        if self.enabled and self.stateful:
+            return jnp.zeros((self.n_total, dim), jnp.float32)
+        return None
+
+    def _aggregate(self, U, w, ctx, state):
+        sp = self.spec
+        f = int(sp.get("byzantine_client_num", max(1, U.shape[0] // 4)))
+        t = self.type
+        rng = jax.random.fold_in(ctx["rng"], 0xDEF)
+        if t == "krum":
+            return dfs.krum(U, w, f, multi=False), state
+        if t == "multikrum":
+            return dfs.krum(U, w, f, multi=True, k=sp.get("krum_param_k")), state
+        if t == "bulyan":
+            return dfs.bulyan(U, w, f), state
+        if t == "wise_median":
+            return dfs.coordinate_median(U, w), state
+        if t == "trimmed_mean":
+            return dfs.trimmed_mean(U, w, int(sp.get("beta", f))), state
+        if t in ("geo_median", "rfa"):
+            return dfs.geometric_median(U, w, int(sp.get("iters", 10))), state
+        if t == "cclip":
+            return dfs.cclip(U, w, float(sp.get("tau", 10.0)),
+                             int(sp.get("iters", 3))), state
+        if t in ("norm_diff_clipping", "diff_clipping"):
+            mx = float(sp.get("norm_bound", 3.0))
+            Uc = jax.vmap(lambda u: dfs.norm_clip_update(u, mx))(U)
+            return dfs._wmean(Uc, w), state
+        if t == "weak_dp":
+            return dfs.weak_dp_aggregate(
+                U, w, rng, float(sp.get("clip", 1.0)),
+                float(sp.get("stddev", 0.025))), state
+        if t == "robust_learning_rate":
+            return dfs.robust_learning_rate_aggregate(
+                U, w, float(sp.get("threshold", 0.5))), state
+        if t == "residual_reweight":
+            return dfs.residual_reweight_aggregate(U, w), state
+        if t == "outlier_detection":
+            w2 = dfs.outlier_detection_weights(U, w)
+            return dfs._wmean(U, w2), state
+        if t == "3sigma":
+            w2 = dfs.three_sigma_weights(U, w)
+            return dfs._wmean(U, w2), state
+        if t == "3sigma_geo":
+            center = dfs.geometric_median(U, w)
+            w2 = dfs.three_sigma_weights(U, w, center)
+            return dfs._wmean(U, w2), state
+        if t in ("foolsgold", "3sigma_foolsgold"):
+            hist = state.at[ctx["ids"]].add(U)
+            lr = dfs.foolsgold_weights(hist[ctx["ids"]])
+            w2 = w * lr
+            if t == "3sigma_foolsgold":
+                w2 = dfs.three_sigma_weights(U, w2)
+            return dfs._wmean(U, w2), hist
+        if t == "cross_round":
+            prev = state[ctx["ids"]]
+            w2 = dfs.cross_round_weights(U, prev, w,
+                                         float(self.spec.get("threshold", 0.0)))
+            return dfs._wmean(U, w2), state.at[ctx["ids"]].set(U)
+        if t == "slsgd":
+            b = int(sp.get("trim_param_b", 0))
+            agg = dfs.trimmed_mean(U, w, b) if b else dfs._wmean(U, w)
+            return agg, state
+        if t in ("wbc", "soteria"):  # client-side transforms; plain mean here
+            return dfs._wmean(U, w), state
+        raise ValueError(f"defense {t!r} not dispatchable")
+
+    def update_transform(self) -> Optional[Callable]:
+        """Client-side defenses → postprocess_update hook."""
+        if not self.enabled:
+            return None
+        sp = self.spec
+        if self.type == "wbc":
+            def f(upd, rng):
+                U, unflat = dfs.stack_flat(jax.tree.map(lambda x: x[None], upd))
+                out = dfs.wbc_update_transform(
+                    U[0], rng, float(sp.get("eta", 0.1)),
+                    float(sp.get("noise_std", 0.1)))
+                return unflat(out)
+            return f
+        if self.type == "soteria":
+            def f(upd, rng):
+                U, unflat = dfs.stack_flat(jax.tree.map(lambda x: x[None], upd))
+                out = dfs.soteria_update_transform(
+                    U[0], float(sp.get("prune_ratio", 0.5)))
+                return unflat(out)
+            return f
+        return None
+
+    def postprocess_agg(self) -> Optional[Callable[[Pytree, dict], Pytree]]:
+        """Global-model post-processing (SLSGD moving average, CRFL)."""
+        if not self.enabled:
+            return None
+        sp = self.spec
+        if self.type == "slsgd":
+            alpha = float(sp.get("alpha", 1.0))
+
+            def f(agg, ctx):
+                # agg is a *delta*; moving average on the delta scales it
+                return jax.tree.map(lambda a: alpha * a, agg)
+            return f
+        if self.type == "crfl":
+            clip, sigma = float(sp.get("clip", 15.0)), float(sp.get("sigma", 0.01))
+
+            def f(agg, ctx):
+                U, unflat = dfs.stack_flat(jax.tree.map(lambda x: x[None], agg))
+                rng = jax.random.fold_in(ctx["rng"], 0xCF1)
+                return unflat(dfs.crfl_postprocess(U[0], rng, clip, sigma))
+            return f
+        return None
+
+
+def build_server_pipeline(
+    attacker: FedAttacker, defender: FedDefender
+) -> Optional[Callable]:
+    """Compose attack→defense into the round engine's aggregate_full hook.
+    Returns None when neither side needs the full update set."""
+    need_full = (attacker.enabled and attacker.type in
+                 ("byzantine", "model_replacement", "backdoor", "lazy_worker")) \
+        or (defender.enabled and defender.type not in ("wbc", "soteria"))
+    if not need_full:
+        return None
+
+    def aggregate_full(stacked: Pytree, weights: jax.Array, ctx: dict):
+        U, unflat = dfs.stack_flat(stacked)
+        if isinstance(ctx["state"], dict):
+            atk_st, dfs_st = ctx["state"].get("atk"), ctx["state"].get("dfs")
+        else:
+            atk_st, dfs_st = None, ctx["state"]
+        U, atk_st = attacker.poison_updates(U, weights, ctx, atk_st)
+        if defender.enabled:
+            agg, dfs_st = defender._aggregate(U, weights, ctx, dfs_st)
+        else:
+            agg = dfs._wmean(U, weights)
+        return unflat(agg), {"atk": atk_st, "dfs": dfs_st}
+
+    return aggregate_full
+
+
+def init_pipeline_state(attacker: FedAttacker, defender: FedDefender,
+                        params: Pytree, client_num_per_round: int) -> Pytree:
+    dim = _flat_dim(params)
+    return {
+        "atk": attacker.init_state(client_num_per_round, dim),
+        "dfs": defender.init_state(dim),
+    }
+
+
+def from_config(cfg: Config) -> tuple[FedAttacker, FedDefender]:
+    t = cfg.train_args
+    return (FedAttacker(cfg.security_args, t.client_num_per_round),
+            FedDefender(cfg.security_args, t.client_num_in_total))

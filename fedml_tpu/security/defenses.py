@@ -1,0 +1,309 @@
+"""Robust-aggregation defenses as batched jnp ops over stacked client updates.
+
+TPU-native replacement for the reference's per-client Python/torch loops
+(reference: core/security/defense/*.py, 23 files, dispatched by
+core/security/fedml_defender.py:55-90). The reference materializes a
+`List[Tuple[weight, OrderedDict]]` and loops; here every defense is a pure
+function over a stacked flat update matrix `U: [m, D]` + weights `[m]`, so it
+jits, fuses into the round program, and runs on the MXU (pairwise-distance
+matrices are one matmul).
+
+Defense taxonomy (matches how FedMLDefender wires hooks,
+core/alg_frame/server_aggregator.py:58-76):
+- reweighting  (U, w) -> w'        : krum-select, 3-sigma family, foolsgold,
+                                     outlier detection  — zero/adjust weights
+- aggregating  (U, w) -> u_agg     : median, trimmed mean, geometric median/
+                                     RFA, bulyan, cclip, robust-LR
+- per-update   (u)    -> u'        : norm clipping, weak-DP clip, WBC noise
+- post-agg     (u_agg, prev) -> u' : SLSGD moving average, CRFL clip+noise
+
+All functions take/return flat [m, D]; `stack_flat`/`unstack_flat` convert
+from/to stacked pytrees.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+# ------------------------------------------------------------- flat helpers
+def stack_flat(stacked: Pytree) -> tuple[jax.Array, Callable[[jax.Array], Pytree]]:
+    """Stacked pytree (leaves [m, ...]) -> (U [m, D], unflatten(u [D]) -> tree)."""
+    leaves, treedef = jax.tree.flatten(stacked)
+    m = leaves[0].shape[0]
+    shapes = [l.shape[1:] for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    U = jnp.concatenate([l.reshape(m, -1) for l in leaves], axis=1)
+
+    def unflatten(u: jax.Array) -> Pytree:
+        out, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            out.append(u[off : off + size].reshape(shape))
+            off += size
+        return jax.tree.unflatten(treedef, out)
+
+    return U, unflatten
+
+
+def _wmean(U: jax.Array, w: jax.Array) -> jax.Array:
+    w = w / jnp.maximum(w.sum(), 1e-12)
+    return w @ U
+
+
+def _pairwise_sqdist(U: jax.Array) -> jax.Array:
+    """[m, m] squared euclidean distances — one gram matmul on the MXU."""
+    sq = jnp.sum(U * U, axis=1)
+    return jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (U @ U.T), 0.0)
+
+
+# ----------------------------------------------------------- krum / bulyan
+def krum_scores(U: jax.Array, num_byzantine: int) -> jax.Array:
+    """Krum score = sum of sq-dists to the m-f-2 nearest neighbors
+    (reference: defense/krum_defense.py; Blanchard et al. 2017)."""
+    m = U.shape[0]
+    d2 = _pairwise_sqdist(U)
+    d2 = d2.at[jnp.arange(m), jnp.arange(m)].set(jnp.inf)
+    k = max(1, m - num_byzantine - 2)
+    nearest = -jax.lax.top_k(-d2, k)[0]  # k smallest per row
+    return nearest.sum(axis=1)
+
+
+def krum(U: jax.Array, w: jax.Array, num_byzantine: int,
+         multi: bool = False, k: Optional[int] = None) -> jax.Array:
+    """krum / multikrum (reference: krum_defense.py; constants.py:3,15).
+    Returns the aggregate: the single best update, or the mean of the k best."""
+    scores = krum_scores(U, num_byzantine)
+    if not multi:
+        return U[jnp.argmin(scores)]
+    k = k or max(1, U.shape[0] - num_byzantine)
+    _, idx = jax.lax.top_k(-scores, k)
+    return _wmean(U[idx], w[idx])
+
+
+def bulyan(U: jax.Array, w: jax.Array, num_byzantine: int) -> jax.Array:
+    """Bulyan (reference: bulyan_defense.py; Mhamdi et al. 2018): multikrum-
+    select theta = m - 2f updates, then per-coordinate trimmed mean of the
+    beta = theta - 2f values closest to the coordinate median."""
+    m = U.shape[0]
+    f = num_byzantine
+    theta = max(1, m - 2 * f)
+    scores = krum_scores(U, f)
+    _, idx = jax.lax.top_k(-scores, theta)
+    S = U[idx]
+    beta = max(1, theta - 2 * f)
+    med = jnp.median(S, axis=0)
+    dist = jnp.abs(S - med[None, :])
+    _, sel = jax.lax.top_k(-dist.T, beta)  # [D, beta] closest-to-median rows
+    return jnp.take_along_axis(S.T, sel, axis=1).mean(axis=1)
+
+
+# ------------------------------------------------- coordinate-wise statistics
+def coordinate_median(U: jax.Array, w: jax.Array) -> jax.Array:
+    """(reference: coordinate_wise_median_defense.py; Yin et al. 2018)"""
+    return jnp.median(U, axis=0)
+
+
+def trimmed_mean(U: jax.Array, w: jax.Array, trim_b: int) -> jax.Array:
+    """Drop the b largest and b smallest per coordinate, mean the rest
+    (reference: coordinate_wise_trimmed_mean_defense.py, common/utils.py
+    trimmed_mean)."""
+    m = U.shape[0]
+    b = int(min(trim_b, (m - 1) // 2))
+    if b == 0:
+        return U.mean(axis=0)
+    s = jnp.sort(U, axis=0)
+    return s[b : m - b].mean(axis=0)
+
+
+def geometric_median(U: jax.Array, w: jax.Array, iters: int = 10,
+                     eps: float = 1e-6) -> jax.Array:
+    """Smoothed Weiszfeld (reference: geometric_median_defense.py &
+    RFA_defense.py; Pillutla et al. RFA). Fixed iteration count → lax.fori."""
+    z0 = _wmean(U, w)
+
+    def body(_, z):
+        d = jnp.maximum(jnp.linalg.norm(U - z[None, :], axis=1), eps)
+        beta = w / d
+        return (beta @ U) / jnp.maximum(beta.sum(), 1e-12)
+
+    return jax.lax.fori_loop(0, iters, body, z0)
+
+
+rfa = geometric_median  # constants.py:9 DEFENSE_RFA
+
+
+# ---------------------------------------------------------------- filtering
+def three_sigma_weights(U: jax.Array, w: jax.Array,
+                        center: Optional[jax.Array] = None) -> jax.Array:
+    """3-sigma outlier filter (reference: three_sigma_defense.py): score each
+    client by distance to the center (coordinate median by default,
+    geometric median for '3sigma_geo'); zero the weight of clients whose
+    score exceeds mean + 3*std."""
+    c = coordinate_median(U, w) if center is None else center
+    scores = jnp.linalg.norm(U - c[None, :], axis=1)
+    # robust location/scale: median + 1.4826*MAD (the plain mean/std the name
+    # suggests is itself corrupted by the outliers being filtered; the
+    # reference's score pipeline has the same failure mode)
+    med = jnp.median(scores)
+    mad = jnp.maximum(1.4826 * jnp.median(jnp.abs(scores - med)), 1e-6)
+    keep = (scores <= med + 3.0 * mad).astype(w.dtype)
+    return w * keep
+
+
+def outlier_detection_weights(U: jax.Array, w: jax.Array, k: int = 2) -> jax.Array:
+    """k-NN-distance outlier score filter (reference: outlier_detection.py):
+    clients whose mean distance to their k nearest neighbors exceeds
+    mean + 2*std are dropped."""
+    m = U.shape[0]
+    d2 = _pairwise_sqdist(U)
+    d2 = d2.at[jnp.arange(m), jnp.arange(m)].set(jnp.inf)
+    k = min(k, m - 1)
+    nearest = -jax.lax.top_k(-d2, k)[0]
+    scores = jnp.sqrt(nearest).mean(axis=1)
+    med = jnp.median(scores)
+    mad = jnp.maximum(1.4826 * jnp.median(jnp.abs(scores - med)), 1e-6)
+    keep = (scores <= med + 3.0 * mad).astype(w.dtype)
+    return w * keep
+
+
+def foolsgold_weights(history: jax.Array) -> jax.Array:
+    """FoolsGold (reference: foolsgold_defense.py; Fung et al. 2020): cosine
+    similarity of per-client *historical* aggregate updates -> sybil credit.
+    `history`: [m, D] cumulative updates. Returns per-client lr in [0, 1]."""
+    norms = jnp.maximum(jnp.linalg.norm(history, axis=1, keepdims=True), 1e-12)
+    cs = (history / norms) @ (history / norms).T
+    m = cs.shape[0]
+    cs = cs.at[jnp.arange(m), jnp.arange(m)].set(0.0)
+    maxcs = cs.max(axis=1)
+    # pardoning: rescale similarities of honest clients
+    pard = jnp.where(maxcs[None, :] > maxcs[:, None],
+                     cs * (maxcs[:, None] / jnp.maximum(maxcs[None, :], 1e-12)), cs)
+    wv = 1.0 - pard.max(axis=1)
+    wv = jnp.clip(wv, 0.0, 1.0)
+    wv = wv / jnp.maximum(wv.max(), 1e-12)
+    # logit squashing, as in the paper
+    wv = jnp.where(wv == 1.0, 0.99, wv)
+    lr = jnp.log(wv / (1.0 - wv) + 1e-12) + 0.5
+    return jnp.clip(lr, 0.0, 1.0)
+
+
+def cross_round_weights(U: jax.Array, prev_U: jax.Array, w: jax.Array,
+                        threshold: float = 0.0) -> jax.Array:
+    """Cross-round consistency (reference: cross_round_defense.py): clients
+    whose update flips direction vs their previous round (cosine below
+    threshold) are down-weighted to zero this round."""
+    num = jnp.sum(U * prev_U, axis=1)
+    den = jnp.maximum(
+        jnp.linalg.norm(U, axis=1) * jnp.linalg.norm(prev_U, axis=1), 1e-12
+    )
+    cos = num / den
+    fresh = jnp.linalg.norm(prev_U, axis=1) < 1e-9  # no history yet
+    keep = jnp.logical_or(cos >= threshold, fresh).astype(w.dtype)
+    return w * keep
+
+
+# ------------------------------------------------------------- clipping family
+def norm_clip_update(u: jax.Array, max_norm: float) -> jax.Array:
+    """(reference: norm_diff_clipping_defense.py — clips the client-global
+    delta norm; constants.py:1,17)"""
+    n = jnp.linalg.norm(u)
+    return u * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+
+
+def weak_dp_aggregate(U: jax.Array, w: jax.Array, rng: jax.Array,
+                      clip: float = 1.0, stddev: float = 0.025) -> jax.Array:
+    """(reference: weak_dp_defense.py): clip each update, mean, add small
+    gaussian noise to the aggregate."""
+    Uc = jax.vmap(lambda u: norm_clip_update(u, clip))(U)
+    agg = _wmean(Uc, w)
+    return agg + stddev * jax.random.normal(rng, agg.shape)
+
+
+def cclip(U: jax.Array, w: jax.Array, tau: float = 10.0, iters: int = 3,
+          center: Optional[jax.Array] = None) -> jax.Array:
+    """Centered clipping (reference: cclip_defense.py; Karimireddy et al.
+    2021): iterate v <- v + mean_i clip(u_i - v, tau)."""
+    v0 = jnp.zeros(U.shape[1], U.dtype) if center is None else center
+
+    def body(_, v):
+        diff = U - v[None, :]
+        n = jnp.linalg.norm(diff, axis=1, keepdims=True)
+        clipped = diff * jnp.minimum(1.0, tau / jnp.maximum(n, 1e-12))
+        return v + _wmean(clipped, w)
+
+    return jax.lax.fori_loop(0, iters, body, v0)
+
+
+def robust_learning_rate_aggregate(U: jax.Array, w: jax.Array,
+                                   threshold: float = 0.5) -> jax.Array:
+    """Robust learning rate (reference: robust_learning_rate_defense.py;
+    Ozdayi et al. 2021): per-coordinate sign vote; coordinates where the
+    |weighted sign sum| is below threshold*sum(w) get a flipped sign."""
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    vote = jnp.abs((w @ jnp.sign(U)) / wsum)
+    lr = jnp.where(vote >= threshold, 1.0, -1.0)
+    return lr * _wmean(U, w)
+
+
+def residual_reweight_aggregate(U: jax.Array, w: jax.Array,
+                                iters: int = 3, delta: float = 1e-6) -> jax.Array:
+    """Residual-based reweighting (reference:
+    residual_based_reweighting_defense.py; Fu et al. 2019). IRLS: repeatedly
+    reweight clients by a Huber-style function of their residual to the
+    current robust estimate. (The reference runs per-parameter repeated-median
+    regression; this is the same estimator family, computed on the full
+    update vector — one matmul per iteration instead of a python loop per
+    scalar parameter.)"""
+    z0 = coordinate_median(U, w)
+
+    def body(_, z):
+        r = jnp.linalg.norm(U - z[None, :], axis=1)
+        med = jnp.median(r)
+        s = jnp.maximum(1.4826 * med, delta)  # MAD scale
+        ww = w / jnp.maximum(r / s, 1.0)      # Huber weight
+        return _wmean(U, ww)
+
+    return jax.lax.fori_loop(0, iters, body, z0)
+
+
+# --------------------------------------------------------------- post-agg
+def slsgd_postprocess(agg: jax.Array, prev_global: jax.Array,
+                      alpha: float = 1.0) -> jax.Array:
+    """SLSGD moving average (reference: slsgd_defense.py:60-70):
+    new = (1-alpha)*old + alpha*agg. (Pair with trimmed_mean for option 2.)"""
+    return (1.0 - alpha) * prev_global + alpha * agg
+
+
+def crfl_postprocess(agg: jax.Array, rng: jax.Array, clip: float = 15.0,
+                     sigma: float = 0.01) -> jax.Array:
+    """CRFL certified robustness (reference: crfl_defense.py; Xie et al.
+    2021): clip the global model norm, then perturb with gaussian noise."""
+    return norm_clip_update(agg, clip) + sigma * jax.random.normal(rng, agg.shape)
+
+
+def wbc_update_transform(u: jax.Array, rng: jax.Array, eta: float = 0.1,
+                         noise_std: float = 0.1) -> jax.Array:
+    """FL-WBC client-side perturbation (reference: wbc_defense.py:9-23; Sun
+    et al. 2021): perturb the parameter subspace where the update is small
+    (where long-lasting attack effects hide) with laplace noise."""
+    noise = noise_std * jax.random.laplace(rng, u.shape)
+    small = jnp.abs(u) - eta * jnp.abs(noise) <= 0.0
+    return jnp.where(small, u + eta * noise, u)
+
+
+def soteria_update_transform(u: jax.Array, prune_ratio: float = 0.5) -> jax.Array:
+    """Soteria-style leakage defense (reference: soteria_defense.py; Sun et
+    al. 2021 'Provable defense'): prune the smallest-magnitude fraction of
+    the update so reconstruction attacks lose the low-signal coordinates the
+    inversion relies on. (The reference perturbs the representation layer
+    during training; on the update vector the equivalent sparsification is
+    applied post-hoc.)"""
+    k = max(1, int(u.size * (1.0 - prune_ratio)))
+    _, idx = jax.lax.top_k(jnp.abs(u), k)
+    return jnp.zeros_like(u).at[idx].set(u[idx])

@@ -16,7 +16,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import dp as dp_mod
+from .. import security as sec_mod
 from ..algorithms import build_algorithm
+from ..compression import make_compression_transform
 from ..config import BACKEND_XLA, Config
 from ..core.algorithm import eval_step_fn
 from ..data.fed_dataset import FedDataset
@@ -26,6 +29,20 @@ from ..ops import tree as tu
 from ..parallel.mesh import make_mesh
 from ..parallel.round import build_round_fn, shard_fed_data
 from ..utils.events import recorder
+
+
+def _compose(*fns):
+    """Chain optional (upd, rng) -> upd transforms; None entries are skipped."""
+    fns = [f for f in fns if f is not None]
+    if not fns:
+        return None
+
+    def chained(upd, rng):
+        for i, f in enumerate(fns):
+            upd = f(upd, jax.random.fold_in(rng, i + 0x9A))
+        return upd
+
+    return chained
 
 
 def _pad_test_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
@@ -75,8 +92,40 @@ class Simulator:
             t.federated_optimizer, self.model.apply, t,
             t.client_num_in_total, t.client_num_per_round,
         )
+
+        # -------- plugins: security, DP, compression (SURVEY.md §2.5/§2.4)
+        self.attacker, self.defender = sec_mod.from_config(cfg)
+        self.dp = dp_mod.from_config(cfg)
+        comp = make_compression_transform(
+            t.extra.get("compression", "none"),
+            float(t.extra.get("compression_ratio", 0.05)),
+            int(t.extra.get("quantize_bits", 8)),
+        )
+        post_update = _compose(
+            self.defender.update_transform(), comp, self.dp.client_transform()
+        )
+        agg_full = sec_mod.build_server_pipeline(self.attacker, self.defender)
+        dp_server = self.dp.server_transform()
+        dfs_post = self.defender.postprocess_agg()
+        post_agg = None
+        if dp_server is not None or dfs_post is not None:
+            def post_agg(agg, ctx):  # noqa: E306
+                if dfs_post is not None:
+                    agg = dfs_post(agg, ctx)
+                if dp_server is not None:
+                    agg = dp_server(agg, jax.random.fold_in(ctx["rng"], 0xD9))
+                return agg
+
         group = int(t.extra.get("clients_per_device_parallel", 1))
-        self.round_fn = build_round_fn(self.alg, self.mesh, group_size=group)
+        self.round_fn = build_round_fn(
+            self.alg, self.mesh, group_size=group,
+            aggregate_full=agg_full, postprocess_update=post_update,
+            postprocess_agg=post_agg,
+            num_real_clients=t.client_num_per_round,
+        )
+        self.hook_state = sec_mod.init_pipeline_state(
+            self.attacker, self.defender, self.params, t.client_num_per_round
+        ) if agg_full is not None else None
 
         self.server_state = self.alg.server_init(self.params, cfg)
         if self.alg.client_state_init is not None:
@@ -88,15 +137,29 @@ class Simulator:
         else:
             self.client_states = jnp.zeros((self.dataset.num_clients,))
 
-        self.data = shard_fed_data(
-            {
-                "x": self.dataset.x_train,
-                "y": self.dataset.y_train,
-                "mask": self.dataset.mask_train,
-            },
-            self.mesh,
-        )
-        self.counts = jnp.asarray(self.dataset.counts, dtype=jnp.float32)
+        raw = {
+            "x": self.dataset.x_train,
+            "y": self.dataset.y_train,
+            "mask": self.dataset.mask_train,
+        }
+        # data-poisoning attacks mutate host arrays before upload (reference:
+        # fedml_attacker.poison_data hook, client_trainer.py:32-38)
+        raw = self.attacker.poison_dataset(raw, self.num_classes)
+        counts = np.asarray(self.dataset.counts, np.float32)
+        if self.mesh is not None:
+            # the stacked client axis must divide the mesh; pad with zero-mask
+            # ghost clients (never sampled — sample_clients draws < num_clients)
+            d = self.mesh.devices.size
+            pad = (-raw["x"].shape[0]) % d
+            if pad:
+                raw = {
+                    k: np.concatenate(
+                        [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                    ) for k, v in raw.items()
+                }
+                counts = np.concatenate([counts, np.zeros(pad, np.float32)])
+        self.data = shard_fed_data(raw, self.mesh)
+        self.counts = jnp.asarray(counts)
 
         xb, yb, mb = _pad_test_batches(
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
@@ -139,11 +202,15 @@ class Simulator:
         with recorder.span("train", round=round_idx):
             out = self.round_fn(
                 self.server_state, self.client_states, self.data,
-                jnp.asarray(ids), jnp.asarray(weights), rng,
+                jnp.asarray(ids), jnp.asarray(weights), rng, self.hook_state,
             )
             metrics = jax.tree.map(float, jax.device_get(out.metrics))
         self.server_state = out.server_state
         self.client_states = out.client_states
+        self.hook_state = out.hook_state
+        self.dp.step_round()
+        if self.dp.enabled and self.dp.accountant is not None:
+            metrics["dp_epsilon"] = self.dp.get_epsilon()
         return metrics
 
     def evaluate(self) -> dict:

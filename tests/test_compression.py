@@ -1,0 +1,82 @@
+"""Compression transforms (reference test model: python/tests/security/* use
+synthetic weight pytrees; same here)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import compression as C
+
+
+def _tree(rng=0):
+    k = jax.random.key(rng)
+    return {
+        "w": jax.random.normal(jax.random.fold_in(k, 0), (32, 16)),
+        "b": jax.random.normal(jax.random.fold_in(k, 1), (16,)),
+    }
+
+
+def test_topk_sparsity_and_values():
+    t = _tree()
+    out = C.topk_compress(t, ratio=0.1)
+    for name, x in t.items():
+        o = out[name]
+        k = max(1, int(x.size * 0.1))
+        assert int((o != 0).sum()) <= k
+        # kept entries are exact copies
+        nz = np.nonzero(np.asarray(o).ravel())
+        assert np.allclose(np.asarray(o).ravel()[nz], np.asarray(x).ravel()[nz])
+
+
+def test_eftopk_error_feedback_accumulates():
+    t = _tree()
+    res = jax.tree.map(jnp.zeros_like, t)
+    sparse, res2 = C.eftopk_compress(t, res, ratio=0.1)
+    # residual + sparse == original (lossless decomposition)
+    for k in t:
+        assert np.allclose(np.asarray(sparse[k] + res2[k]), np.asarray(t[k]), atol=1e-6)
+    # second round: residual is carried in
+    sparse3, _ = C.eftopk_compress(t, res2, ratio=0.1)
+    assert not np.allclose(np.asarray(sparse3["w"]), np.asarray(sparse["w"]))
+
+
+def test_randk_unbiased():
+    t = {"w": jnp.ones((1000,))}
+    outs = [C.randk_compress(t, 0.25, jax.random.key(i))["w"] for i in range(30)]
+    mean = np.mean([np.asarray(o) for o in outs], axis=0)
+    assert abs(mean.mean() - 1.0) < 0.15  # unbiased estimator
+
+
+def test_quantize_bounded_error():
+    t = _tree()
+    out = C.quantize_compress(t, bits=8)
+    for k in t:
+        scale = float(jnp.max(jnp.abs(t[k])))
+        assert np.max(np.abs(np.asarray(out[k] - t[k]))) <= scale / 2**7 + 1e-6
+
+
+def test_qsgd_unbiased():
+    t = {"w": jnp.full((500,), 0.5)}
+    outs = [C.qsgd_compress(t, 4, jax.random.key(i))["w"] for i in range(50)]
+    mean = np.mean([np.asarray(o) for o in outs], axis=0)
+    assert abs(mean.mean() - 0.5) < 0.05
+
+
+def test_wire_roundtrip():
+    v = np.random.RandomState(0).randn(256).astype(np.float32)
+    enc = C.encode_sparse(v, 0.1)
+    dec = C.decode_sparse(enc)
+    assert dec.shape == v.shape
+    nz = np.nonzero(dec)
+    assert np.allclose(dec[nz], v[nz])
+    assert len(nz[0]) == max(1, int(256 * 0.1))
+
+
+def test_registry_dispatch():
+    assert C.make_compression_transform("none") is None
+    f = C.make_compression_transform("topk", ratio=0.5)
+    t = _tree()
+    out = f(t, jax.random.key(0))
+    assert out["w"].shape == t["w"].shape
+    with pytest.raises(ValueError):
+        C.make_compression_transform("bogus")

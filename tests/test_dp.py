@@ -1,0 +1,104 @@
+"""DP mechanisms, frames, and RDP accountant (reference test model:
+core/dp/test/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.config import Config
+from fedml_tpu.dp import FedDP, from_config
+from fedml_tpu.dp.accountant import RDPAccountant, compute_rdp, get_privacy_spent
+from fedml_tpu.dp.mechanisms import (
+    add_gaussian_noise, gaussian_sigma, laplace_scale, make_mechanism,
+)
+
+
+def test_gaussian_sigma_formula():
+    # sigma = sqrt(2 ln(1.25/delta)) * s / eps (reference: gaussian.py:17-21)
+    s = gaussian_sigma(0.5, 1e-5, 1.0)
+    assert np.isclose(s, np.sqrt(2 * np.log(1.25e5)) / 0.5)
+    with pytest.raises(ValueError):
+        gaussian_sigma(2.0, 1e-5)  # eps > 1 rejected, same as reference :12
+
+
+def test_noise_statistics():
+    t = {"w": jnp.zeros((20000,))}
+    out = add_gaussian_noise(jax.random.key(0), t, 2.0)
+    assert abs(float(out["w"].std()) - 2.0) < 0.1
+
+
+def test_mechanism_dispatch():
+    g = make_mechanism("gaussian", 0.5, 1e-5, 1.0)
+    l = make_mechanism("laplace", 0.5, 1e-5, 1.0)
+    t = {"w": jnp.zeros((100,))}
+    assert g(jax.random.key(0), t)["w"].shape == (100,)
+    assert l(jax.random.key(0), t)["w"].shape == (100,)
+    with pytest.raises(ValueError):
+        make_mechanism("bogus", 1, 1e-5, 1)
+
+
+def test_rdp_accountant_monotone_and_sane():
+    acc = RDPAccountant(noise_multiplier=1.1, sampling_rate=0.01, target_delta=1e-5)
+    acc.step(10)
+    e10 = acc.get_epsilon()
+    acc.step(90)
+    e100 = acc.get_epsilon()
+    assert 0 < e10 < e100 < 10.0  # composition grows, small-q stays tight
+
+
+def test_rdp_q1_matches_closed_form():
+    # q=1: rdp(a) = a/(2 z^2) exactly
+    orders = [2.0, 4.0, 8.0]
+    rdp = compute_rdp(1.0, 2.0, 1, orders)
+    assert np.allclose(rdp, [a / 8.0 for a in orders])
+
+
+def test_privacy_spent_decreasing_in_noise():
+    orders = list(range(2, 64))
+    e_low, _ = get_privacy_spent(orders, compute_rdp(0.1, 0.8, 50, orders), 1e-5)
+    e_high, _ = get_privacy_spent(orders, compute_rdp(0.1, 2.0, 50, orders), 1e-5)
+    assert e_high < e_low
+
+
+def _dp_cfg(solution):
+    return Config.from_dict({
+        "train_args": {"client_num_in_total": 10, "client_num_per_round": 4,
+                       "comm_round": 8},
+        "dp_args": {"enable_dp": True, "dp_solution_type": solution,
+                    "epsilon": 0.9, "delta": 1e-5, "clipping_norm": 1.0},
+    })
+
+
+def test_ldp_clips_and_noises():
+    dp = from_config(_dp_cfg("ldp"))
+    f = dp.client_transform()
+    big = {"w": jnp.full((64,), 100.0)}
+    out = f(big, jax.random.key(0))
+    # clipped to norm 1 then noised: norm far below the original 800
+    assert float(jnp.linalg.norm(out["w"])) < 50.0
+    assert dp.server_transform() is None
+
+
+def test_cdp_server_noise():
+    dp = from_config(_dp_cfg("cdp"))
+    fc, fs = dp.client_transform(), dp.server_transform()
+    clipped = fc({"w": jnp.full((4,), 10.0)}, jax.random.key(0))
+    assert float(jnp.linalg.norm(clipped["w"])) <= 1.0 + 1e-5
+    noised = fs({"w": jnp.zeros((1000,))}, jax.random.key(0))
+    assert float(noised["w"].std()) > 0.0
+
+
+def test_dp_clip_only():
+    dp = from_config(_dp_cfg("dp_clip"))
+    out = dp.client_transform()({"w": jnp.full((64,), 5.0)}, jax.random.key(0))
+    assert np.isclose(float(jnp.linalg.norm(out["w"])), 1.0, atol=1e-5)
+
+
+def test_nbafl_coord_clip():
+    from fedml_tpu.dp import _coord_clip
+    # NbAFL.py:42-46: elementwise divide by max(1, |w|/C)
+    out = _coord_clip({"w": jnp.array([5.0, -5.0, 0.1])}, 1.0)
+    assert np.allclose(np.asarray(out["w"]), [1.0, -1.0, 0.1])
+    dp = from_config(_dp_cfg("nbafl"))
+    noised = dp.client_transform()({"w": jnp.zeros((3,))}, jax.random.key(1))
+    assert noised["w"].shape == (3,)  # clip + gaussian noise applied
