@@ -68,7 +68,9 @@ def randk_compress(update: Pytree, ratio: float, rng: jax.Array) -> Pytree:
         flat = x.ravel()
         k = _leaf_k(flat.size, ratio)
         idx = jax.random.choice(path_rng, flat.size, (k,), replace=False)
-        out = jnp.zeros_like(flat).at[idx].set(flat[idx] / ratio)
+        # unbiased scale is size/k (1/ratio is wrong when int(size*ratio)
+        # rounds, e.g. small bias leaves)
+        out = jnp.zeros_like(flat).at[idx].set(flat[idx] * (flat.size / k))
         return out.reshape(x.shape)
 
     leaves, treedef = jax.tree.flatten(update)

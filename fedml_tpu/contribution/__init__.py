@@ -1,0 +1,212 @@
+"""Contribution assessment — client valuation by subset utility.
+
+TPU-native replacement for the reference's assessors (reference:
+core/contribution/ — ContributionAssessorManager
+contribution_assessor_manager.py:9, LeaveOneOut leave_one_out.py:10,
+GTGShapleyValue gtg_shapley_value.py:8, MRShapleyValue mr_shapley_value.py:9;
+run from ServerAggregator.assess_contribution).
+
+Design difference: the reference re-aggregates torch OrderedDicts and runs a
+full torch eval per subset on the host. Here subset utility is a *batched
+device computation*: the candidate aggregates for many subsets are stacked
+along a leading axis and evaluated with one vmapped/jitted eval — subsets
+become rows, not round-trips.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def subset_aggregate(stacked: Pytree, weights: jax.Array,
+                     member_mask: jax.Array) -> Pytree:
+    """Weighted mean over a client subset, selected by a [m] 0/1 mask
+    (reference: get_aggregated_model_with_client_subset,
+    base_contribution_assessor.py:34-44). Mask-multiplied weights keep the
+    shape static -> vmappable over many subsets at once."""
+    from ..ops import tree as tu
+    return tu.tree_weighted_mean(stacked, weights * member_mask)
+
+
+def batched_subset_utilities(stacked: Pytree, weights: jax.Array,
+                             masks: np.ndarray,
+                             utility_fn: Callable[[Pytree], jax.Array]) -> np.ndarray:
+    """Evaluate utility(aggregate(subset)) for a batch of subset masks [S, m]
+    in ONE jitted vmap — the replacement for the reference's per-subset
+    aggregate+validate host loop (gtg_shapley_value.py:88-93)."""
+
+    @jax.jit
+    def run(masks_):
+        def one(mask):
+            return utility_fn(subset_aggregate(stacked, weights, mask))
+
+        return jax.vmap(one)(masks_)
+
+    return np.asarray(run(jnp.asarray(masks, jnp.float32)))
+
+
+def leave_one_out(stacked: Pytree, weights: jax.Array, client_ids: Sequence[int],
+                  utility_fn: Callable[[Pytree], jax.Array]) -> dict[int, float]:
+    """LOO contribution: U(all) - U(all \\ {i}) (reference:
+    leave_one_out.py:26-105, which loops subsets on the host; here one batched
+    eval of m+1 candidate models)."""
+    m = len(client_ids)
+    masks = np.ones((m + 1, m), np.float32)
+    for i in range(m):
+        masks[i + 1, i] = 0.0
+    utils = batched_subset_utilities(stacked, weights, masks, utility_fn)
+    full = float(utils[0])
+    return {cid: full - float(utils[i + 1]) for i, cid in enumerate(client_ids)}
+
+
+class GTGShapley:
+    """Guided-Truncation-Gradient Shapley (reference: gtg_shapley_value.py:8-126,
+    Liu et al. 2022): permutation-sampled marginal contributions with
+    within-round truncation and between-round convergence checks."""
+
+    def __init__(self, eps: float = 0.001, round_trunc_threshold: float = 0.001,
+                 convergence_criteria: float = 0.05, last_k: int = 10,
+                 max_percentage: float = 0.8, seed: int = 0):
+        self.eps = eps
+        self.round_trunc_threshold = round_trunc_threshold
+        self.convergence_criteria = convergence_criteria
+        self.last_k = last_k
+        self.max_number = 0
+        self.max_percentage = max_percentage
+        self.rng = np.random.RandomState(seed)
+        self.shapley_values_by_round: dict[int, dict[int, float]] = {}
+
+    def _converged(self, records: list[np.ndarray], k: int, n: int) -> bool:
+        """(reference: _is_not_converged, gtg_shapley_value.py:112-124):
+        rolling mean of the last_k cumulative SV estimates stabilizes."""
+        if k >= max(n, 1) * 2 ** min(n, 10) * self.max_percentage + 1:
+            return True
+        if k <= self.last_k:
+            return False
+        all_vals = np.cumsum(records, 0) / np.arange(1, len(records) + 1)[:, None]
+        errors = np.mean(
+            np.abs(all_vals[-self.last_k:] - all_vals[-1:])
+            / (np.abs(all_vals[-1:]) + 1e-12), axis=1,
+        )
+        return bool(np.max(errors) < self.convergence_criteria)
+
+    def run(self, stacked: Pytree, weights: jax.Array, client_ids: Sequence[int],
+            utility_fn: Callable[[Pytree], jax.Array],
+            acc_last_round: float, acc_aggregated: float,
+            round_idx: int = 0) -> dict[int, float]:
+        n = len(client_ids)
+        if abs(acc_aggregated - acc_last_round) <= self.round_trunc_threshold:
+            # round truncation (gtg_shapley_value.py:62-66)
+            out = {cid: 0.0 for cid in client_ids}
+            self.shapley_values_by_round[round_idx] = out
+            return out
+
+        util: dict[tuple, float] = {(): acc_last_round,
+                                    tuple(sorted(range(n))): acc_aggregated}
+        records: list[np.ndarray] = []
+        k = 0
+        while not self._converged(records, k, n):
+            for first in range(n):
+                k += 1
+                order = np.concatenate([
+                    [first],
+                    self.rng.permutation([i for i in range(n) if i != first]),
+                ]).astype(int)
+                v = np.zeros(n + 1)
+                v[0] = acc_last_round
+                marg = np.zeros(n)
+                # batch all prefix subsets of this permutation in one eval
+                prefixes = [tuple(sorted(order[:j])) for j in range(1, n + 1)]
+                todo = [pfx for pfx in prefixes if pfx not in util]
+                if todo:
+                    masks = np.zeros((len(todo), n), np.float32)
+                    for r, pfx in enumerate(todo):
+                        masks[r, list(pfx)] = 1.0
+                    vals = batched_subset_utilities(stacked, weights, masks,
+                                                    utility_fn)
+                    util.update({pfx: float(x) for pfx, x in zip(todo, vals)})
+                for j in range(1, n + 1):
+                    # within-permutation truncation (gtg:84-95)
+                    if abs(acc_aggregated - v[j - 1]) >= self.eps:
+                        v[j] = util[prefixes[j - 1]]
+                    else:
+                        v[j] = v[j - 1]
+                    marg[order[j - 1]] = v[j] - v[j - 1]
+                records.append(marg)
+
+        sv = (np.cumsum(records, 0) / np.arange(1, len(records) + 1)[:, None])[-1]
+        out = {cid: float(sv[i]) for i, cid in enumerate(client_ids)}
+        self.shapley_values_by_round[round_idx] = out
+        return out
+
+
+def mr_shapley(stacked: Pytree, weights: jax.Array, client_ids: Sequence[int],
+               utility_fn: Callable[[Pytree], jax.Array],
+               baseline_utility: float = 0.0) -> dict[int, float]:
+    """Exact multi-round Shapley over the full power set (reference:
+    mr_shapley_value.py:27-63) — exponential; for small cohorts. All 2^m
+    subset utilities in one batched eval."""
+    m = len(client_ids)
+    subsets = list(itertools.chain.from_iterable(
+        itertools.combinations(range(m), r) for r in range(1, m + 1)
+    ))
+    masks = np.zeros((len(subsets), m), np.float32)
+    for r, s in enumerate(subsets):
+        masks[r, list(s)] = 1.0
+    utils = dict(zip(subsets, batched_subset_utilities(stacked, weights, masks,
+                                                       utility_fn)))
+    # U(empty) is the caller's baseline (previous round's accuracy), NOT the
+    # utility of an all-zero aggregate
+    utils[()] = np.float32(baseline_utility)
+    subsets = [()] + subsets
+    import math
+    sv = np.zeros(m)
+    for i in range(m):
+        for s in subsets:
+            if i in s:
+                continue
+            s_with = tuple(sorted(s + (i,)))
+            weight = math.factorial(len(s)) * math.factorial(m - len(s) - 1) \
+                / math.factorial(m)
+            sv[i] += weight * (float(utils[s_with]) - float(utils[s]))
+    return {cid: float(sv[i]) for i, cid in enumerate(client_ids)}
+
+
+class ContributionAssessorManager:
+    """Config-driven facade (reference: contribution_assessor_manager.py:9-60
+    builds the assessor from args.contribution_alg)."""
+
+    def __init__(self, alg: str = "GTG", **kwargs):
+        self.alg = (alg or "").upper()
+        self._gtg = GTGShapley(**kwargs) if self.alg == "GTG" else None
+        self.history: dict[int, dict[int, float]] = {}
+
+    def run(self, stacked, weights, client_ids, utility_fn,
+            acc_last_round=0.0, acc_aggregated=1.0, round_idx=0):
+        if self.alg == "LOO":
+            out = leave_one_out(stacked, weights, client_ids, utility_fn)
+        elif self.alg == "GTG":
+            out = self._gtg.run(stacked, weights, client_ids, utility_fn,
+                                acc_last_round, acc_aggregated, round_idx)
+        elif self.alg == "MR":
+            out = mr_shapley(stacked, weights, client_ids, utility_fn)
+        else:
+            raise ValueError(f"unknown contribution_alg {self.alg!r}; "
+                             "one of LOO | GTG | MR")
+        self.history[round_idx] = out
+        return out
+
+    def get_final_contribution_assignment(self) -> dict[int, float]:
+        """Sum per-round values per client (reference:
+        contribution_assessor_manager.py:59)."""
+        out: dict[int, float] = {}
+        for vals in self.history.values():
+            for cid, v in vals.items():
+                out[cid] = out.get(cid, 0.0) + v
+        return out

@@ -108,8 +108,11 @@ class FedDP:
             return None
         d = self.args
         if self.solution == CDP:
-            # sensitivity of the weighted mean of norm-C updates is C/m
-            sigma = self._sigma * d.clipping_norm / max(self.m, 1)
+            # sensitivity of the weighted mean of norm-C updates is C/m —
+            # replace the mechanism's configured sensitivity with it (dividing
+            # it out first; multiplying _sigma directly would double-count)
+            sigma = (self._sigma / max(d.sensitivity, 1e-12)) \
+                * d.clipping_norm / max(self.m, 1)
             return lambda agg, rng: self._noise(rng, agg, sigma)
         if self.solution == NBAFL:
             # NbAFL.py:48-56: extra down-link noise only when T > sqrt(N)*L

@@ -1,0 +1,25 @@
+"""Secure multi-party computation kernels for federated aggregation.
+
+TPU-native replacement for the reference's `core/mpc/` (reference:
+core/mpc/secagg.py 395 LoC, core/mpc/lightsecagg.py 205 LoC, used by the
+cross_silo/{secagg,lightsecagg}/ manager variants and the Android C++
+LightSecAgg). Crypto runs host-side on vectorized numpy mod-p arrays; masked
+updates flow through the normal comm/aggregation path.
+"""
+from .finite import (
+    DEFAULT_PRIME, dequantize, lagrange_coeffs, lcc_decode, lcc_encode,
+    modular_inv, prg_mask, quantize, shamir_reconstruct, shamir_share,
+)
+from .lightsecagg import (
+    aggregate_encoded_masks, decode_aggregate_mask, lightsecagg_roundtrip,
+    mask_encoding,
+)
+from .secagg import SecAggClient, SecAggServer, secagg_roundtrip
+
+__all__ = [
+    "DEFAULT_PRIME", "quantize", "dequantize", "modular_inv", "prg_mask",
+    "shamir_share", "shamir_reconstruct", "lagrange_coeffs", "lcc_encode",
+    "lcc_decode", "SecAggClient", "SecAggServer", "secagg_roundtrip",
+    "mask_encoding", "aggregate_encoded_masks", "decode_aggregate_mask",
+    "lightsecagg_roundtrip",
+]

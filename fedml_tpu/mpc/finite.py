@@ -1,0 +1,144 @@
+"""Finite-field primitives for secure aggregation — vectorized numpy mod-p.
+
+Replaces the reference's scalar/python finite-field toolkit (reference:
+core/mpc/secagg.py:8-79 modular_inv/divmod/PI/Lagrange-coefficients;
+quantization my_q/my_q_inv :344-383; Shamir/BGW :164-212, additive shares
+:316-327). All arithmetic here is batched numpy int64 with explicit mod-p
+reductions, so share generation/reconstruction over million-parameter vectors
+is array ops, not per-coefficient python loops.
+
+The default prime fits signed int64 products via Python-int fallback where
+needed; 2**31-1 (Mersenne) keeps products within int64 exactly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_PRIME = 2**31 - 1  # Mersenne prime: a*b fits in int64 before reduction
+
+
+def modular_inv(a: np.ndarray | int, p: int = DEFAULT_PRIME):
+    """Fermat inverse a^(p-2) mod p (reference: secagg.py:8-22 uses an
+    iterative EEA per scalar; pow-mod vectorizes)."""
+    if isinstance(a, (int, np.integer)):
+        return pow(int(a), p - 2, p)
+    return np.array([pow(int(x), p - 2, p) for x in np.asarray(a).ravel()],
+                    dtype=np.int64).reshape(np.shape(a))
+
+
+def quantize(x: np.ndarray, q_bits: int = 16, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Float -> field element: round(x * 2^q), negatives wrap to p - |.|
+    (reference: my_q, secagg.py:344-349)."""
+    scaled = np.round(np.asarray(x, np.float64) * (1 << q_bits)).astype(np.int64)
+    return np.mod(scaled, p)
+
+
+def dequantize(xq: np.ndarray, q_bits: int = 16,
+               p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Field element -> float: values above p//2 are negative wrap-arounds
+    (reference: my_q_inv + transform_finite_to_tensor, secagg.py:359-383).
+    The p//2 split supports sums whose magnitude stays below p/2^(q_bits+1)."""
+    xq = np.mod(np.asarray(xq, np.int64), p)
+    half = p // 2
+    signed = np.where(xq > half, xq - p, xq)
+    return signed.astype(np.float64) / (1 << q_bits)
+
+
+def _powers(points: np.ndarray, deg: int, p: int) -> np.ndarray:
+    """Vandermonde rows [len(points), deg+1] mod p."""
+    out = np.ones((len(points), deg + 1), dtype=np.int64)
+    for j in range(1, deg + 1):
+        out[:, j] = (out[:, j - 1] * points) % p
+    return out
+
+
+def shamir_share(secret: np.ndarray, n: int, t: int, rng: np.random.Generator,
+                 p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Shamir t-of-n sharing of a vector secret (reference: BGW_encoding,
+    secagg.py:164-178). Returns shares [n, D]; share i evaluates the degree-t
+    polynomial at point i+1."""
+    secret = np.mod(np.asarray(secret, np.int64), p)
+    D = secret.size
+    coeffs = np.concatenate(
+        [secret.reshape(1, D),
+         rng.integers(0, p, size=(t, D), dtype=np.int64)], axis=0
+    )  # [t+1, D]
+    points = np.arange(1, n + 1, dtype=np.int64)
+    V = _powers(points, t, p)  # [n, t+1]
+    # mod-p matmul: accumulate per degree to stay in int64
+    shares = np.zeros((n, D), dtype=np.int64)
+    for j in range(t + 1):
+        shares = (shares + V[:, j : j + 1] * coeffs[j : j + 1]) % p
+    return shares
+
+
+def shamir_reconstruct(shares: np.ndarray, idxs: list[int],
+                       p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Reconstruct the secret from >= t+1 shares via Lagrange at 0
+    (reference: BGW_decoding + gen_BGW_lambda_s, secagg.py:180-212)."""
+    points = np.asarray([i + 1 for i in idxs], dtype=np.int64)
+    k = len(points)
+    lam = np.ones(k, dtype=np.int64)
+    for i in range(k):
+        num, den = 1, 1
+        for j in range(k):
+            if i == j:
+                continue
+            num = (num * (-points[j] % p)) % p
+            den = (den * ((points[i] - points[j]) % p)) % p
+        lam[i] = (num * modular_inv(int(den), p)) % p
+    out = np.zeros(shares.shape[1], dtype=np.int64)
+    for i in range(k):
+        out = (out + lam[i] * shares[i]) % p
+    return out
+
+
+def lagrange_coeffs(alpha_s: np.ndarray, beta_s: np.ndarray,
+                    p: int = DEFAULT_PRIME) -> np.ndarray:
+    """U[i,j] = prod_{l!=j} (alpha_i - beta_l) / (beta_j - beta_l) mod p
+    (reference: gen_Lagrange_coeffs, secagg.py:59-80)."""
+    a = np.asarray(alpha_s, np.int64)
+    b = np.asarray(beta_s, np.int64)
+    U = np.zeros((len(a), len(b)), dtype=np.int64)
+    for i in range(len(a)):
+        for j in range(len(b)):
+            num, den = 1, 1
+            for l in range(len(b)):
+                if l == j:
+                    continue
+                num = (num * ((int(a[i]) - int(b[l])) % p)) % p
+                den = (den * ((int(b[j]) - int(b[l])) % p)) % p
+            U[i, j] = (num * modular_inv(den, p)) % p
+    return U
+
+
+def lcc_encode(X: np.ndarray, alpha_s: np.ndarray, beta_s: np.ndarray,
+               p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Lagrange-coded computing encode: X [K, D] chunks -> evaluations at
+    alpha points [N, D] (reference: LCC_encoding_with_points, secagg.py:41-48)."""
+    U = lagrange_coeffs(alpha_s, beta_s, p)  # [N, K]
+    N, D = U.shape[0], X.shape[1]
+    out = np.zeros((N, D), dtype=np.int64)
+    for j in range(U.shape[1]):
+        out = (out + U[:, j : j + 1] * X[j : j + 1]) % p
+    return out
+
+
+def lcc_decode(f_eval: np.ndarray, eval_points: np.ndarray,
+               target_points: np.ndarray, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Decode evaluations back to values at target points (reference:
+    LCC_decoding_with_points, secagg.py:50-57)."""
+    U = lagrange_coeffs(target_points, eval_points, p)
+    K, D = U.shape[0], f_eval.shape[1]
+    out = np.zeros((K, D), dtype=np.int64)
+    for j in range(U.shape[1]):
+        out = (out + U[:, j : j + 1] * f_eval[j : j + 1]) % p
+    return out
+
+
+def prg_mask(seed: int, size: int, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Deterministic pseudo-random field vector from a shared seed (the
+    reference uses np.random masks keyed by agreed secrets)."""
+    return np.random.default_rng(seed % (2**63)).integers(
+        0, p, size=size, dtype=np.int64
+    )

@@ -1,0 +1,167 @@
+"""SecAgg — pairwise-mask secure aggregation with dropout recovery.
+
+Protocol (Bonawitz et al. 2017), the reference's cross-silo SecAgg kernel
+(reference: core/mpc/secagg.py — key agreement my_pk_gen/my_key_agreement
+:329-342, masking model_masking :83-116, additive shares Gen_Additive_SS
+:316-327; driven by cross_silo/secagg/sa_fedml_* managers):
+
+1. each client i has a DH keypair; pairwise seed s_ij = agree(sk_i, pk_j).
+2. client i uploads  y_i = x_i + b_i + sum_{j>i} PRG(s_ij) - sum_{j<i} PRG(s_ji)
+   (all in the field); pairwise masks cancel in the sum.
+3. self-mask seed b_i is Shamir-shared to all clients; if i drops out, t+1
+   survivors reconstruct b_i's *pairwise* seeds instead; if i survives, they
+   reconstruct b_i and subtract it.
+
+Host-side crypto (numpy mod-p); the masked vectors are ordinary int64 arrays
+that ride the normal comm layer. TPU note: masking/unmasking is elementwise
+add mod p — O(D) on CPU is fine; the heavy part (the sum) stays on device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .finite import (
+    DEFAULT_PRIME, dequantize, prg_mask, quantize, shamir_reconstruct,
+    shamir_share,
+)
+
+_G = 5  # public DH generator (reference: my_pk_gen uses g**sk mod p)
+
+
+@dataclasses.dataclass
+class SecAggClient:
+    """One participant's key material + masking logic."""
+    idx: int
+    num_clients: int
+    threshold: int                      # Shamir t (t+1 reconstructors needed)
+    p: int = DEFAULT_PRIME
+    q_bits: int = 16
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        rng = np.random.default_rng(self.seed)
+        self.sk = int(rng.integers(2, self.p - 2))
+        self.pk = pow(_G, self.sk, self.p)
+        # the self-mask seed is Shamir-shared, i.e. reconstructed mod p —
+        # it must live in the field or reconstruction returns seed mod p
+        self.self_seed = int(rng.integers(0, self.p))
+        self._rng = rng
+
+    # --- round 0: keys
+    def public_key(self) -> int:
+        return self.pk
+
+    def agree(self, peer_pk: int) -> int:
+        """DH shared secret -> PRG seed (reference: my_key_agreement,
+        secagg.py:337-342)."""
+        return pow(peer_pk, self.sk, self.p) % (2**62)
+
+    # --- round 1: share the self-mask seed
+    def share_self_seed(self) -> np.ndarray:
+        """Shamir shares [n, 1] of the self-mask seed, one per client."""
+        return shamir_share(
+            np.asarray([self.self_seed], np.int64),
+            self.num_clients, self.threshold, self._rng, self.p,
+        )
+
+    # --- round 2: masked input
+    def mask(self, x: np.ndarray, peer_pks: dict[int, int]) -> np.ndarray:
+        """y_i = quantize(x_i) + PRG(b_i) + sum_{j>i} PRG(s_ij) - sum_{j<i}."""
+        D = x.size
+        y = quantize(x, self.q_bits, self.p)
+        y = (y + prg_mask(self.self_seed, D, self.p)) % self.p
+        for j, pk in peer_pks.items():
+            if j == self.idx:
+                continue
+            pair = prg_mask(self.agree(pk), D, self.p)
+            y = (y + pair) % self.p if j > self.idx else (y - pair) % self.p
+        return y
+
+
+class SecAggServer:
+    """Aggregates masked inputs; recovers from dropouts with survivor shares
+    (reference flow: cross_silo/secagg/sa_fedml_server_manager.py)."""
+
+    def __init__(self, num_clients: int, threshold: int, dim: int,
+                 p: int = DEFAULT_PRIME, q_bits: int = 16):
+        self.n, self.t, self.D = num_clients, threshold, dim
+        self.p, self.q_bits = p, q_bits
+
+    def aggregate(
+        self,
+        masked: dict[int, np.ndarray],             # surviving i -> y_i
+        self_seed_shares: dict[int, dict[int, np.ndarray]],
+        # self_seed_shares[holder][owner] = holder's share of owner's b seed
+        pairwise_seeds_of_dropped: dict[int, dict[int, int]],
+        # dropped j -> {peer i: s_ij} reconstructed by survivors
+        weights: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Sum surviving masked vectors, strip surviving clients' self-masks
+        (reconstructed from shares) and dropped clients' pairwise masks."""
+        survivors = sorted(masked)
+        agg = np.zeros(self.D, np.int64)
+        for i in survivors:
+            agg = (agg + masked[i]) % self.p
+
+        # subtract each survivor's self-mask b_i
+        for i in survivors:
+            share_rows = []
+            holders = []
+            for h in survivors:
+                if i in self_seed_shares.get(h, {}):
+                    holders.append(h)
+                    share_rows.append(self_seed_shares[h][i])
+                if len(holders) == self.t + 1:
+                    break
+            if len(holders) < self.t + 1:
+                raise ValueError(f"not enough shares to unmask client {i}")
+            seed = int(shamir_reconstruct(
+                np.stack([r.reshape(-1) for r in share_rows]), holders, self.p
+            )[0])
+            agg = (agg - prg_mask(seed, self.D, self.p)) % self.p
+
+        # strip pairwise masks involving dropped clients
+        for j, seeds in pairwise_seeds_of_dropped.items():
+            for i in survivors:
+                if i not in seeds:
+                    continue
+                pair = prg_mask(seeds[i], self.D, self.p)
+                # client i applied +pair if j > i else -pair; remove it
+                agg = (agg - pair) % self.p if j > i else (agg + pair) % self.p
+
+        return dequantize(agg, self.q_bits, self.p)
+
+
+def secagg_roundtrip(vectors: list[np.ndarray], threshold: Optional[int] = None,
+                     drop: Optional[list[int]] = None, seed: int = 0) -> np.ndarray:
+    """Reference-style end-to-end driver (the shape of
+    cross_silo/secagg/*'s message exchange, in-process): returns the sum of
+    the surviving clients' vectors, computed only from masked data."""
+    n, D = len(vectors), vectors[0].size
+    t = threshold if threshold is not None else max(1, n // 2)
+    drop = set(drop or [])
+    clients = [SecAggClient(i, n, t, seed=seed + i) for i in range(n)]
+    pks = {i: c.public_key() for i, c in enumerate(clients)}
+
+    shares = {}  # holder -> owner -> share
+    all_shares = {i: c.share_self_seed() for i, c in enumerate(clients)}
+    for holder in range(n):
+        if holder in drop:
+            continue
+        shares[holder] = {owner: all_shares[owner][holder]
+                          for owner in range(n) if owner not in drop}
+
+    masked = {i: c.mask(vectors[i], pks)
+              for i, c in enumerate(clients) if i not in drop}
+
+    # survivors reconstruct the *pairwise* seeds of dropped clients (in the
+    # real protocol these come from shares of sk_j; the math is identical)
+    pair_seeds = {j: {i: clients[j].agree(pks[i])
+                      for i in range(n) if i not in drop}
+                  for j in drop}
+
+    server = SecAggServer(n, t, D)
+    return server.aggregate(masked, shares, pair_seeds)

@@ -245,7 +245,9 @@ def build_round_fn(
             )
         return finalize(server_state, agg, summed, full_cstates, hook_state)
 
-    return jax.jit(round_body, donate_argnums=(0, 1))
+    # donate server/client/hook state: all three are dead after the call, and
+    # the hook state can be a [N, D] defense history that must update in place
+    return jax.jit(round_body, donate_argnums=(0, 1, 6))
 
 
 def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:

@@ -80,6 +80,14 @@ class FedAttacker:
             return atk.byzantine_attack(U, mal, rng, mode), state
         if self.type in ("model_replacement", "backdoor"):
             scale = float(self.spec.get("scale_factor", self.m))
+            if self.type == "backdoor":
+                # the scaled update must be the one trained on poisoned data:
+                # mark the sampled slots whose *global id* is a poisoned client
+                # (poison_dataset used the same ids), not the first slots
+                pids = jnp.asarray(
+                    list(self.spec.get("poisoned_client_ids", [0])), jnp.int32
+                )
+                mal = jnp.isin(ctx["ids"], pids)
             return atk.model_replacement_attack(U, mal, scale), state
         if self.type == "lazy_worker":
             prev = state if state is not None else jnp.zeros_like(U)

@@ -1,0 +1,154 @@
+"""MPC (SecAgg/LightSecAgg) + contribution assessors (reference test model:
+python/tests/contribution_assessor/test_loo.py, core/mpc usage in
+cross_silo/{secagg,lightsecagg})."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import mpc
+from fedml_tpu.contribution import (
+    ContributionAssessorManager, GTGShapley, leave_one_out, mr_shapley,
+    subset_aggregate,
+)
+
+
+# ------------------------------------------------------------------ finite
+def test_quantize_roundtrip():
+    x = np.array([1.5, -2.25, 0.0, 100.125])
+    assert np.allclose(mpc.dequantize(mpc.quantize(x)), x)
+
+
+def test_modular_inv():
+    p = mpc.DEFAULT_PRIME
+    for a in [2, 12345, p - 2]:
+        assert (a * mpc.modular_inv(a, p)) % p == 1
+
+
+def test_shamir_share_reconstruct():
+    rng = np.random.default_rng(0)
+    secret = rng.integers(0, mpc.DEFAULT_PRIME, 16, dtype=np.int64)
+    shares = mpc.shamir_share(secret, n=5, t=2, rng=rng)
+    # any 3 shares reconstruct
+    rec = mpc.shamir_reconstruct(shares[[0, 2, 4]], [0, 2, 4])
+    assert (rec == secret).all()
+    rec2 = mpc.shamir_reconstruct(shares[[1, 3, 4]], [1, 3, 4])
+    assert (rec2 == secret).all()
+    # 2 shares give garbage (information-theoretic hiding)
+    bad = mpc.shamir_reconstruct(shares[[0, 1]], [0, 1])
+    assert not (bad == secret).all()
+
+
+def test_lcc_encode_decode():
+    p = mpc.DEFAULT_PRIME
+    rng = np.random.default_rng(1)
+    X = rng.integers(0, p, (3, 8), dtype=np.int64)  # K=3 chunks
+    alpha = np.arange(1, 6, dtype=np.int64)         # N=5 eval points
+    beta = np.arange(6, 9, dtype=np.int64)
+    enc = mpc.lcc_encode(X, alpha, beta, p)
+    dec = mpc.lcc_decode(enc[[0, 2, 4]], alpha[[0, 2, 4]], beta, p)
+    assert (dec == X).all()
+
+
+# ------------------------------------------------------------------ secagg
+def test_secagg_no_dropout():
+    rng = np.random.RandomState(0)
+    vecs = [rng.randn(32) for _ in range(4)]
+    agg = mpc.secagg_roundtrip(vecs, threshold=1)
+    assert np.allclose(agg, np.sum(vecs, axis=0), atol=1e-3)
+
+
+def test_secagg_with_dropout():
+    rng = np.random.RandomState(1)
+    vecs = [rng.randn(16) for _ in range(5)]
+    agg = mpc.secagg_roundtrip(vecs, threshold=2, drop=[1, 3])
+    expect = vecs[0] + vecs[2] + vecs[4]
+    assert np.allclose(agg, expect, atol=1e-3)
+
+
+def test_secagg_masked_vectors_hide_input():
+    c = mpc.SecAggClient(0, 2, 1, seed=0)
+    peer = mpc.SecAggClient(1, 2, 1, seed=1)
+    x = np.ones(8)
+    y = c.mask(x, {0: c.public_key(), 1: peer.public_key()})
+    assert not np.allclose(mpc.dequantize(y), x, atol=1.0)  # masked
+
+
+def test_lightsecagg_no_dropout():
+    rng = np.random.RandomState(2)
+    vecs = [rng.randn(20) for _ in range(4)]
+    agg = mpc.lightsecagg_roundtrip(vecs, K=2, T=1)
+    assert np.allclose(agg, np.sum(vecs, axis=0), atol=1e-3)
+
+
+def test_lightsecagg_with_dropout():
+    rng = np.random.RandomState(3)
+    vecs = [rng.randn(12) for _ in range(5)]
+    agg = mpc.lightsecagg_roundtrip(vecs, K=2, T=1, drop=[4])
+    assert np.allclose(agg, np.sum(vecs[:4], axis=0), atol=1e-3)
+
+
+def test_lightsecagg_too_many_dropouts():
+    vecs = [np.ones(4) for _ in range(4)]
+    with pytest.raises(ValueError):
+        mpc.lightsecagg_roundtrip(vecs, K=2, T=1, drop=[0, 1, 2])
+
+
+# ------------------------------------------------------------- contribution
+def _toy_problem(m=4):
+    """Utility = negative distance of aggregate to target; client 0 carries
+    the target direction, client m-1 is useless."""
+    target = jnp.ones(8)
+    stacked = {"w": jnp.stack(
+        [target] + [0.5 * target] * (m - 2) + [jnp.zeros(8)])}
+    weights = jnp.ones(m)
+
+    def utility(aggtree):
+        return -jnp.linalg.norm(aggtree["w"] - target)
+
+    return stacked, weights, utility
+
+
+def test_subset_aggregate_mask():
+    stacked = {"w": jnp.asarray([[2.0], [4.0], [6.0]])}
+    agg = subset_aggregate(stacked, jnp.ones(3), jnp.asarray([1.0, 0.0, 1.0]))
+    assert float(agg["w"][0]) == 4.0
+
+
+def test_loo_ranks_clients():
+    stacked, w, util = _toy_problem()
+    loo = leave_one_out(stacked, w, [10, 11, 12, 13], util)
+    assert loo[10] > loo[13]  # target-carrier beats zero-contributor
+
+
+def test_mr_shapley_exact_ranks():
+    stacked, w, util = _toy_problem()
+    sv = mr_shapley(stacked, w, [0, 1, 2, 3], util)
+    assert sv[0] > sv[1] >= sv[2] > sv[3]
+
+
+def test_gtg_converges_and_ranks():
+    stacked, w, util = _toy_problem()
+    gtg = GTGShapley(seed=0, convergence_criteria=0.2, last_k=4)
+    sv = gtg.run(stacked, w, [0, 1, 2, 3], util,
+                 acc_last_round=-10.0, acc_aggregated=-1.0)
+    assert sv[0] > sv[3]
+
+
+def test_gtg_round_truncation():
+    stacked, w, util = _toy_problem()
+    gtg = GTGShapley()
+    sv = gtg.run(stacked, w, [0, 1, 2, 3], util,
+                 acc_last_round=0.5, acc_aggregated=0.5)
+    assert all(v == 0.0 for v in sv.values())
+
+
+def test_manager_dispatch_and_final_assignment():
+    stacked, w, util = _toy_problem()
+    mgr = ContributionAssessorManager("LOO")
+    mgr.run(stacked, w, [0, 1, 2, 3], util, round_idx=0)
+    mgr.run(stacked, w, [0, 1, 2, 3], util, round_idx=1)
+    final = mgr.get_final_contribution_assignment()
+    assert final[0] > final[3]
+    with pytest.raises(ValueError):
+        ContributionAssessorManager("bogus").run(stacked, w, [0], util)
