@@ -1,0 +1,21 @@
+"""Distributed communication backend — L0/L1 of the layer map (SURVEY.md §1).
+
+Replaces the reference's transport zoo (reference:
+core/distributed/communication/ — MPI/gRPC/TRPC/MQTT+S3 variants, all moving
+pickled Messages) with two transports on a shared tensor-native wire format:
+loopback (in-process, tests) and gRPC (cross-silo DCN). Intra-pod "messaging"
+does not exist here at all — it's XLA collectives inside the round program
+(parallel/round.py), per SURVEY.md §5.8.
+"""
+from .base import BaseTransport, Observer
+from .loopback import LoopbackTransport, get_router
+from .manager import FedCommManager, create_transport
+from .message import Message
+from .serialization import decode, encode
+from .topology import AsymmetricTopologyManager, SymmetricTopologyManager
+
+__all__ = [
+    "BaseTransport", "Observer", "Message", "FedCommManager",
+    "create_transport", "LoopbackTransport", "get_router", "encode", "decode",
+    "SymmetricTopologyManager", "AsymmetricTopologyManager",
+]

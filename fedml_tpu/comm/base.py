@@ -1,0 +1,45 @@
+"""Transport abstraction (reference:
+core/distributed/communication/base_com_manager.py:7-26 BaseCommunicationManager
++ observer.py:4 Observer). A transport moves encoded Message frames between
+integer-addressed processes; the comm manager on top owns dispatch."""
+from __future__ import annotations
+
+import abc
+
+from .message import Message
+
+
+class Observer(abc.ABC):
+    """(reference: observer.py:4-8)"""
+
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None: ...
+
+
+class BaseTransport(abc.ABC):
+    """(reference: base_com_manager.py:7-26 — send_message /
+    add_observer / remove_observer / handle_receive_message /
+    stop_receive_message)"""
+
+    def __init__(self):
+        self._observers: list[Observer] = []
+
+    def add_observer(self, obs: Observer) -> None:
+        self._observers.append(obs)
+
+    def remove_observer(self, obs: Observer) -> None:
+        self._observers.remove(obs)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.type, msg)
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None: ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Blocking receive loop; returns when stopped."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None: ...

@@ -1,0 +1,114 @@
+"""gRPC transport — cross-silo/DCN messaging with tensor-native frames.
+
+Replaces the reference's gRPC backend (reference:
+core/distributed/communication/grpc/grpc_comm_manager.py:30-130 — one server
+per process at GRPC_BASE_PORT+rank, pickled Message inside a proto
+CommRequest; proto/grpc_comm_manager.proto:1-17). Differences:
+- no protoc/codegen: the service is registered with raw bytes
+  (de)serializers via grpc.method_handlers_generic_handler — the frame IS
+  the payload (serialization.py), so there's no pickle and no double-copy.
+- ip table: {rank: "host:port"} dict or csv file (reference uses a csv,
+  grpc_ipconfig.csv).
+"""
+from __future__ import annotations
+
+import csv
+import queue
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from .base import BaseTransport
+from .message import Message
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+_FULL_METHOD = f"/{_SERVICE}/{_METHOD}"
+BASE_PORT = 8890  # reference: grpc_comm_manager.py GRPC_BASE_PORT
+
+
+def load_ip_table(path: str) -> dict[int, str]:
+    """csv rows: receiver_id,ip[,port] (reference: grpc_ipconfig.csv)."""
+    table = {}
+    with open(path) as f:
+        for row in csv.reader(f):
+            if not row or row[0].strip().startswith("#") or row[0] == "receiver_id":
+                continue
+            rank = int(row[0])
+            host = row[1].strip()
+            port = int(row[2]) if len(row) > 2 else BASE_PORT + rank
+            table[rank] = f"{host}:{port}"
+    return table
+
+
+class GrpcTransport(BaseTransport):
+    def __init__(self, rank: int, ip_table: dict[int, str],
+                 port: Optional[int] = None, max_workers: int = 4,
+                 max_message_mb: int = 512):
+        super().__init__()
+        self.rank = rank
+        self.ip_table = dict(ip_table)
+        self.port = port if port is not None else BASE_PORT + rank
+        self._inbox: queue.Queue = queue.Queue()
+        self._running = False
+        opts = [
+            ("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
+            ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024),
+        ]
+        self._opts = opts
+
+        def handle_send(request: bytes, context) -> bytes:
+            self._inbox.put(request)
+            return b"ok"
+
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            _METHOD: grpc.unary_unary_rpc_method_handler(
+                handle_send,
+                request_deserializer=None,   # raw bytes in
+                response_serializer=None,    # raw bytes out
+            )
+        })
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        if bound == 0:
+            raise RuntimeError(
+                f"rank {rank}: could not bind gRPC server to port {self.port} "
+                "(already in use?)"
+            )
+        self._server.start()
+        self._channels: dict[int, grpc.Channel] = {}
+
+    def _stub(self, rank: int):
+        if rank not in self._channels:
+            self._channels[rank] = grpc.insecure_channel(
+                self.ip_table[rank], options=self._opts
+            )
+        return self._channels[rank].unary_unary(
+            _FULL_METHOD, request_serializer=None, response_deserializer=None
+        )
+
+    def send_message(self, msg: Message) -> None:
+        self._stub(msg.receiver_id)(msg.encode())
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                frame = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if frame is None:
+                break
+            self._notify(Message.decode(frame))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(None)
+        for ch in self._channels.values():
+            ch.close()
+        self._server.stop(grace=0.5)

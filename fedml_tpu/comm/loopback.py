@@ -1,0 +1,65 @@
+"""In-process loopback transport — the test/CI backend.
+
+The reference fakes multi-node with multi-process on one box + a public MQTT
+broker (reference: tests/cross-silo/run_cross_silo.sh:1-28); here the
+equivalent is threads + queues in one process: same Message flow, no network.
+Frames still round-trip through encode/decode so serialization is exercised.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import defaultdict
+
+from .base import BaseTransport
+from .message import Message
+
+
+class LoopbackRouter:
+    """Shared mailbox set for one run: rank -> queue of frames."""
+
+    def __init__(self):
+        self._queues: dict[int, queue.Queue] = defaultdict(queue.Queue)
+        self.lock = threading.Lock()
+
+    def mailbox(self, rank: int) -> queue.Queue:
+        with self.lock:
+            return self._queues[rank]
+
+
+_routers: dict[str, LoopbackRouter] = {}
+_routers_lock = threading.Lock()
+
+
+def get_router(run_id: str) -> LoopbackRouter:
+    with _routers_lock:
+        if run_id not in _routers:
+            _routers[run_id] = LoopbackRouter()
+        return _routers[run_id]
+
+
+class LoopbackTransport(BaseTransport):
+    _STOP = object()
+
+    def __init__(self, rank: int, run_id: str = "default"):
+        super().__init__()
+        self.rank = rank
+        self.router = get_router(run_id)
+        self._inbox = self.router.mailbox(rank)
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        frame = msg.encode()  # exercise the wire format even in-process
+        self.router.mailbox(msg.receiver_id).put(frame)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            item = self._inbox.get()
+            if item is self._STOP:
+                break
+            self._notify(Message.decode(item))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._inbox.put(self._STOP)

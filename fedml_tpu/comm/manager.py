@@ -1,0 +1,100 @@
+"""FedCommManager — handler registry + event loop over a pluggable transport.
+
+The reference's L1 (reference: core/distributed/fedml_comm_manager.py —
+run() :25, send_message() :53, register_message_receive_handler() :63,
+backend factory _init_manager() :131-207 selecting
+MPI/gRPC/TRPC/MQTT_S3/...). Here the backend menu is:
+
+- "loopback"  — in-process queues (tests/CI; ≙ the reference faking
+                multi-node with multi-process, run_cross_silo.sh)
+- "grpc"      — DCN messaging, tensor-native frames
+- "xla"       — not a message transport at all: intra-pod aggregation happens
+                as XLA collectives inside the round program (parallel/round.py);
+                requesting it here raises with that explanation
+- "mqtt_s3" / "trpc" / "mpi" — reference backends whose role is covered by
+                grpc+loopback on TPU pods; raise with guidance
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from .base import BaseTransport, Observer
+from .loopback import LoopbackTransport
+from .message import Message
+
+
+class FedCommManager(Observer):
+    def __init__(self, transport: BaseTransport, rank: int = 0):
+        self.transport = transport
+        self.rank = rank
+        self._handlers: dict[str, Callable[[Message], None]] = {}
+        self.transport.add_observer(self)
+        self._thread: Optional[threading.Thread] = None
+
+    # reference API (fedml_comm_manager.py:63)
+    def register_message_receive_handler(
+        self, msg_type: str, handler: Callable[[Message], None]
+    ) -> None:
+        self._handlers[msg_type] = handler
+
+    def send_message(self, msg: Message) -> None:  # :53
+        # the Message's own sender_id is authoritative (callers construct it
+        # with their client id, which need not equal the transport rank)
+        self.transport.send_message(msg)
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise KeyError(
+                f"rank {self.rank}: no handler registered for {msg_type!r} "
+                f"(registered: {sorted(self._handlers)})"
+            )
+        handler(msg)
+
+    def run(self, background: bool = False) -> None:
+        """Enter the receive loop (reference: run() :25 →
+        handle_receive_message). background=True runs it in a daemon thread
+        (the in-process multi-role test topology)."""
+        if background:
+            self._thread = threading.Thread(
+                target=self.transport.handle_receive_message, daemon=True
+            )
+            self._thread.start()
+        else:
+            self.transport.handle_receive_message()
+
+    def stop(self) -> None:
+        self.transport.stop_receive_message()
+        # handlers run on the loop thread and may call stop() themselves
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+
+
+def create_transport(backend: str, rank: int, run_id: str = "default",
+                     ip_table: Optional[dict] = None, **kw) -> BaseTransport:
+    """Backend factory (reference: _init_manager, fedml_comm_manager.py:131)."""
+    b = (backend or "loopback").lower()
+    if b == "loopback":
+        return LoopbackTransport(rank, run_id)
+    if b == "grpc":
+        from .grpc_transport import GrpcTransport, load_ip_table
+        if ip_table is None:
+            raise ValueError("grpc backend needs ip_table={rank: 'host:port'} "
+                             "or a csv path (reference: grpc_ipconfig.csv)")
+        if isinstance(ip_table, str):
+            ip_table = load_ip_table(ip_table)
+        return GrpcTransport(rank, ip_table, **kw)
+    if b == "xla":
+        raise ValueError(
+            "backend='xla' is the in-program collective path (simulation over "
+            "a device mesh, parallel/round.py), not a message transport; use "
+            "'grpc' or 'loopback' for the cross-silo message layer"
+        )
+    if b in ("mqtt_s3", "mqtt", "trpc", "mpi"):
+        raise ValueError(
+            f"backend {b!r} is a reference transport not provided in the TPU "
+            "build; 'grpc' covers cross-silo DCN messaging and 'loopback' "
+            "covers single-box testing"
+        )
+    raise ValueError(f"unknown comm backend {backend!r}")

@@ -1,0 +1,55 @@
+"""Message envelope for the cross-silo comm layer.
+
+Mirrors the reference's Message semantics (reference:
+core/distributed/communication/message.py:5-83 — dict envelope with
+MSG_ARG_KEY_TYPE/SENDER/RECEIVER + model-params payload), with the pickle
+JSON+dict body replaced by the tensor-native wire format (serialization.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from . import serialization
+
+# canonical keys (reference: message.py:9-24)
+ARG_TYPE = "msg_type"
+ARG_SENDER = "sender"
+ARG_RECEIVER = "receiver"
+ARG_MODEL_PARAMS = "model_params"
+ARG_NUM_SAMPLES = "num_samples"
+ARG_CLIENT_STATUS = "client_status"
+ARG_ROUND = "round_idx"
+
+
+@dataclasses.dataclass
+class Message:
+    type: str
+    sender_id: int
+    receiver_id: int
+    params: dict = dataclasses.field(default_factory=dict)
+
+    def add(self, key: str, value: Any) -> "Message":
+        self.params[key] = value
+        return self
+
+    def get(self, key: str, default=None) -> Any:
+        return self.params.get(key, default)
+
+    # reference API names (message.py:40-70)
+    add_params = add
+    get_params = get
+
+    def encode(self) -> bytes:
+        return serialization.encode({
+            ARG_TYPE: self.type,
+            ARG_SENDER: self.sender_id,
+            ARG_RECEIVER: self.receiver_id,
+            "params": self.params,
+        })
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Message":
+        d = serialization.decode(data)
+        return cls(d[ARG_TYPE], int(d[ARG_SENDER]), int(d[ARG_RECEIVER]),
+                   d["params"])

@@ -1,0 +1,91 @@
+"""Tensor-native wire format — no pickle anywhere.
+
+The reference serializes model payloads with pickle over every transport
+(reference: core/distributed/communication/grpc/grpc_comm_manager.py:78-90
+pickle.dumps(msg), mpi/com_manager.py:77 comm.send(python object), MQTT+S3
+JSON + pickled S3 blobs). Pickle is slow for large tensors and unsafe across
+trust boundaries; here the wire format is:
+
+    [4B header_len][header JSON][raw tensor buffers, contiguous]
+
+Pytrees are JSON with ndarray leaves swapped for {"__nd__": i, dtype, shape}
+descriptors pointing into the buffer region — zero-copy on encode (tobytes of
+C-contiguous arrays) and a single frombuffer per tensor on decode.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+Pytree = Any
+_MAGIC = b"FT01"
+
+
+def _encode_obj(obj: Any, buffers: list[bytes]):
+    if isinstance(obj, np.ndarray):
+        idx = len(buffers)
+        arr = np.ascontiguousarray(obj)
+        buffers.append(arr.tobytes())
+        return {"__nd__": idx, "dtype": str(arr.dtype), "shape": list(arr.shape)}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"dict keys must be str for lossless JSON round-trip, got "
+                    f"{type(k).__name__} key {k!r}"
+                )
+        return {k: _encode_obj(v, buffers) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        enc = [_encode_obj(v, buffers) for v in obj]
+        return {"__tuple__": enc} if isinstance(obj, tuple) else enc
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    # jax arrays and other array-likes
+    if hasattr(obj, "__array__"):
+        return _encode_obj(np.asarray(obj), buffers)
+    raise TypeError(f"unserializable type {type(obj)!r} (no pickle fallback by design)")
+
+
+def _decode_obj(obj: Any, buffers: list[memoryview]):
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            buf = buffers[obj["__nd__"]]
+            return np.frombuffer(buf, dtype=np.dtype(obj["dtype"])).reshape(
+                obj["shape"]
+            ).copy()
+        if "__tuple__" in obj:
+            return tuple(_decode_obj(v, buffers) for v in obj["__tuple__"])
+        return {k: _decode_obj(v, buffers) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_obj(v, buffers) for v in obj]
+    return obj
+
+
+def encode(tree: Pytree) -> bytes:
+    """pytree (dict/list/scalars/ndarray/jax arrays) -> framed bytes."""
+    buffers: list[bytes] = []
+    header = _encode_obj(tree, buffers)
+    sizes = [len(b) for b in buffers]
+    head = json.dumps({"tree": header, "sizes": sizes}).encode()
+    return b"".join([_MAGIC, struct.pack("<I", len(head)), head] + buffers)
+
+
+def decode(data: bytes | memoryview) -> Pytree:
+    data = memoryview(data)
+    if bytes(data[:4]) != _MAGIC:
+        raise ValueError("bad frame magic (not a fedml_tpu wire frame)")
+    (hlen,) = struct.unpack("<I", data[4:8])
+    head = json.loads(bytes(data[8 : 8 + hlen]))
+    buffers: list[memoryview] = []
+    off = 8 + hlen
+    for size in head["sizes"]:
+        buffers.append(data[off : off + size])
+        off += size
+    return _decode_obj(head["tree"], buffers)

@@ -1,0 +1,67 @@
+"""Neighbor topologies for decentralized FL.
+
+(reference: core/distributed/topology/symmetric_topology_manager.py:7,
+asymmetric_topology_manager.py:7 — ring-based symmetric/asymmetric neighbor
+matrices used by simulation/sp/decentralized DSGD/PushSum.)
+
+Returns row-stochastic mixing matrices as numpy arrays; the decentralized
+algorithms consume them as gossip weights (a [n, n] matmul on device — the
+whole gossip step is one einsum instead of per-neighbor message loops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class SymmetricTopologyManager:
+    """Ring with `neighbor_num` symmetric neighbors per node (reference:
+    symmetric_topology_manager.py — undirected ring extension)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2):
+        self.n = n
+        self.neighbor_num = min(neighbor_num, n - 1)
+        self.topology = self._build()
+
+    def _build(self) -> np.ndarray:
+        W = np.eye(self.n)
+        half = max(1, self.neighbor_num // 2)
+        for i in range(self.n):
+            for d in range(1, half + 1):
+                W[i, (i + d) % self.n] = 1.0
+                W[i, (i - d) % self.n] = 1.0
+        return W / W.sum(axis=1, keepdims=True)  # row-stochastic
+
+    def get_in_neighbor_idx_list(self, node: int) -> list[int]:
+        return [j for j in range(self.n) if self.topology[node, j] > 0 and j != node]
+
+    get_out_neighbor_idx_list = get_in_neighbor_idx_list  # symmetric
+
+
+class AsymmetricTopologyManager:
+    """Directed ring: each node listens to `in_num` predecessors and pushes to
+    `out_num` successors (reference: asymmetric_topology_manager.py:7)."""
+
+    def __init__(self, n: int, in_num: int = 2, out_num: int = 1):
+        self.n = n
+        self.in_num = min(in_num, n - 1)
+        self.out_num = min(out_num, n - 1)
+        # mixing (listen) matrix: row i averages over in_num predecessors
+        W = np.eye(n)
+        for i in range(n):
+            for d in range(1, self.in_num + 1):
+                W[i, (i - d) % n] = 1.0
+        self.topology = W / W.sum(axis=1, keepdims=True)
+        # push graph: node i pushes to out_num successors (distinct from the
+        # listen graph — that asymmetry is the point of this manager)
+        P_out = np.zeros((n, n))
+        for i in range(n):
+            for d in range(1, self.out_num + 1):
+                P_out[i, (i + d) % n] = 1.0
+        self.out_topology = P_out
+
+    def get_in_neighbor_idx_list(self, node: int) -> list[int]:
+        return [j for j in range(self.n)
+                if self.topology[node, j] > 0 and j != node]
+
+    def get_out_neighbor_idx_list(self, node: int) -> list[int]:
+        return [j for j in range(self.n) if self.out_topology[node, j] > 0]

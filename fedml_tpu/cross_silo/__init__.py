@@ -1,0 +1,17 @@
+"""Cross-silo FL runtime — real multi-org training over the message layer.
+
+(reference: python/fedml/cross_silo/ — Client/Server facade in __init__.py,
+horizontal + hierarchical scenarios, 4,016 LoC.) Layer map position: L3
+(SURVEY.md §1); rides comm/ (L0/L1) below and is driven by runner/init (L4).
+
+Hierarchical scenario: the reference nests torch DDP inside each silo
+(process_group_manager.py); here each silo's accelerators form a local
+jax Mesh inside SiloTrainer — inner gradient all-reduce over ICI, outer
+model exchange over DCN (SURVEY.md §5.8 mapping).
+"""
+from .client import FedClientManager
+from .message_define import *  # noqa: F401,F403
+from .server import FedAggregator, FedServerManager
+from .trainer import SiloTrainer
+
+__all__ = ["FedClientManager", "FedServerManager", "FedAggregator", "SiloTrainer"]

@@ -1,0 +1,75 @@
+"""Cross-silo FL client — master manager FSM.
+
+(reference: cross_silo/client/fedml_client_master_manager.py:22-230 — handlers
+for check_client_status / init / sync_model / finish; __train :227 calls the
+TrainerDistAdapter; hierarchical slaves follow via dist.broadcast_object_list
+:195-207. Here the silo's accelerators are one jax Mesh inside SiloTrainer, so
+there is no slave manager at all.)
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..comm import FedCommManager, Message
+from ..utils.events import recorder
+from . import message_define as md
+from .trainer import SiloTrainer
+
+log = logging.getLogger(__name__)
+
+
+class FedClientManager:
+    def __init__(self, comm: FedCommManager, client_id: int,
+                 trainer: SiloTrainer, server_id: int = 0):
+        self.comm = comm
+        self.client_id = client_id
+        self.server_id = server_id
+        self.trainer = trainer
+        self.done = threading.Event()
+
+        comm.register_message_receive_handler(
+            md.S2C_CHECK_CLIENT_STATUS, self._on_check_status)
+        comm.register_message_receive_handler(md.S2C_INIT_CONFIG, self._on_init)
+        comm.register_message_receive_handler(md.S2C_SYNC_MODEL, self._on_sync)
+        comm.register_message_receive_handler(md.S2C_FINISH, self._on_finish)
+
+    def _on_check_status(self, msg: Message) -> None:
+        m = Message(md.C2S_CLIENT_STATUS, self.client_id, self.server_id)
+        m.add(md.KEY_STATUS, md.STATUS_ONLINE)
+        self.comm.send_message(m)
+
+    def _train_and_send(self, params, round_idx: int) -> None:
+        with recorder.span("train", round=round_idx, client=self.client_id):
+            new_params, n, metrics = self.trainer.train(params, round_idx)
+        out = Message(md.C2S_SEND_MODEL, self.client_id, self.server_id)
+        out.add(md.KEY_MODEL_PARAMS, new_params)
+        out.add(md.KEY_NUM_SAMPLES, n)
+        out.add(md.KEY_METRICS, metrics)
+        self.comm.send_message(out)
+
+    def _on_init(self, msg: Message) -> None:
+        self._train_and_send(msg.get(md.KEY_MODEL_PARAMS),
+                             int(msg.get(md.KEY_ROUND, 0)))
+
+    _on_sync = _on_init
+
+    def _on_finish(self, msg: Message) -> None:
+        m = Message(md.C2S_FINISHED, self.client_id, self.server_id)
+        m.add(md.KEY_STATUS, md.STATUS_FINISHED)
+        try:
+            self.comm.send_message(m)
+        except Exception:  # server may already be gone
+            pass
+        self.done.set()
+        self.comm.stop()
+
+    def run(self, background: bool = False) -> None:
+        self.comm.run(background=background)
+
+    def announce_ready(self) -> None:
+        """Kick the FSM (the transport's CONNECTION_IS_READY event — reference
+        transports synthesize it on connect; loopback/grpc need an explicit
+        poke to the server)."""
+        self.comm.send_message(
+            Message(md.CONNECTION_IS_READY, self.client_id, self.server_id))

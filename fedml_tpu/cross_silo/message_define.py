@@ -1,0 +1,28 @@
+"""Cross-silo message protocol constants (reference:
+cross_silo/server/message_define.py + client/message_define.py — the numeric
+MSG_TYPE_* FSM alphabet; strings here for self-describing wire frames)."""
+
+# connection info (reference: MSG_TYPE_CONNECTION_IS_READY = 0)
+CONNECTION_IS_READY = "connection_ready"
+
+# server -> client (reference: 1, 2, 6, 7)
+S2C_INIT_CONFIG = "s2c_init_config"
+S2C_SYNC_MODEL = "s2c_sync_model"
+S2C_CHECK_CLIENT_STATUS = "s2c_check_client_status"
+S2C_FINISH = "s2c_finish"
+
+# client -> server (reference: 3, 4, 5, 8)
+C2S_SEND_MODEL = "c2s_send_model"
+C2S_CLIENT_STATUS = "c2s_client_status"
+C2S_FINISHED = "c2s_finished"
+
+# payload keys (reference: MSG_ARG_KEY_*)
+KEY_MODEL_PARAMS = "model_params"
+KEY_NUM_SAMPLES = "num_samples"
+KEY_CLIENT_INDEX = "client_idx"
+KEY_ROUND = "round_idx"
+KEY_STATUS = "client_status"
+KEY_METRICS = "metrics"
+
+STATUS_ONLINE = "ONLINE"
+STATUS_FINISHED = "FINISHED"

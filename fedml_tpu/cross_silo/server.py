@@ -1,0 +1,164 @@
+"""Cross-silo FL server — the message-driven FSM.
+
+(reference: cross_silo/server/fedml_server_manager.py:82-246 — handlers for
+connection_ready / client_status / model_from_client; round flow: check
+status → all online → send_init_msg → collect models → aggregate → sync;
+aggregation bookkeeping in server/fedml_aggregator.py:13-104
+add_local_trained_result/check_whether_all_receive/aggregate.)
+
+Aggregation runs on device: stacked numpy updates → tree_weighted_mean (or
+the security pipeline's robust aggregate) in one jit call.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import FedCommManager, Message
+from ..ops import tree as tu
+from ..utils.events import recorder
+from . import message_define as md
+
+Pytree = Any
+log = logging.getLogger(__name__)
+
+
+class FedAggregator:
+    """Result pool + merge (reference: server/fedml_aggregator.py:13-104)."""
+
+    def __init__(self, aggregate_fn: Optional[Callable] = None):
+        self.results: dict[int, tuple[Pytree, float]] = {}
+        self.expected: set[int] = set()
+        self.aggregate_fn = aggregate_fn
+
+    def reset(self, client_ids) -> None:
+        self.results.clear()
+        self.expected = set(client_ids)
+
+    def add_local_trained_result(self, client_id: int, params: Pytree,
+                                 n_samples: float) -> None:
+        self.results[client_id] = (params, n_samples)
+
+    def check_whether_all_receive(self) -> bool:
+        return self.expected.issubset(self.results)
+
+    def aggregate(self) -> Pytree:
+        with recorder.span("agg"):
+            ids = sorted(self.results)
+            stacked = tu.tree_stack([jax.tree.map(jnp.asarray, self.results[i][0])
+                                     for i in ids])
+            weights = jnp.asarray([self.results[i][1] for i in ids], jnp.float32)
+            if self.aggregate_fn is not None:
+                agg = self.aggregate_fn(stacked, weights)
+            else:
+                agg = tu.tree_weighted_mean(stacked, weights)
+            return jax.tree.map(np.asarray, jax.device_get(agg))
+
+
+class FedServerManager:
+    """(reference: FedMLServerManager, fedml_server_manager.py:22-246)"""
+
+    def __init__(self, comm: FedCommManager, client_ids: list[int],
+                 init_params: Pytree, num_rounds: int,
+                 aggregate_fn: Optional[Callable] = None,
+                 eval_fn: Optional[Callable[[Pytree, int], dict]] = None,
+                 client_num_per_round: Optional[int] = None,
+                 sample_seed: int = 0):
+        self.comm = comm
+        self.client_ids = list(client_ids)
+        self.m = client_num_per_round or len(self.client_ids)
+        self.params = init_params
+        self.num_rounds = num_rounds
+        self.round_idx = 0
+        self.aggregator = FedAggregator(aggregate_fn)
+        self.eval_fn = eval_fn
+        self.sample_seed = sample_seed
+        self.client_online: dict[int, bool] = {}
+        self.is_initialized = False
+        self.done = threading.Event()
+        self.history: list[dict] = []
+        self._lock = threading.Lock()
+
+        comm.register_message_receive_handler(
+            md.CONNECTION_IS_READY, self._on_connection_ready)
+        comm.register_message_receive_handler(
+            md.C2S_CLIENT_STATUS, self._on_client_status)
+        comm.register_message_receive_handler(
+            md.C2S_SEND_MODEL, self._on_model_from_client)
+
+    # --- selection (reference: fedml_aggregator.client_selection — seeded by
+    # round, matching fedavg_api.py:127-135)
+    def _select_clients(self, round_idx: int) -> list[int]:
+        if self.m >= len(self.client_ids):
+            return list(self.client_ids)
+        rng = np.random.RandomState(self.sample_seed + round_idx)
+        return sorted(rng.choice(self.client_ids, self.m, replace=False).tolist())
+
+    # ------------------------------------------------------------- handlers
+    def _on_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        self.round_clients = self._select_clients(0)
+        for cid in self.round_clients:
+            self.comm.send_message(
+                Message(md.S2C_CHECK_CLIENT_STATUS, 0, cid))
+
+    def _on_client_status(self, msg: Message) -> None:
+        status = msg.get(md.KEY_STATUS)
+        if status == md.STATUS_FINISHED:
+            return
+        with self._lock:
+            self.client_online[msg.sender_id] = True
+            all_online = all(self.client_online.get(c, False)
+                             for c in self.round_clients)
+            if all_online and not self.is_initialized:
+                self.is_initialized = True
+                self._send_init()
+
+    def _send_init(self) -> None:
+        self.aggregator.reset(self.round_clients)
+        for cid in self.round_clients:
+            m = Message(md.S2C_INIT_CONFIG, 0, cid)
+            m.add(md.KEY_MODEL_PARAMS, self.params)
+            m.add(md.KEY_ROUND, self.round_idx)
+            self.comm.send_message(m)
+
+    def _on_model_from_client(self, msg: Message) -> None:
+        with self._lock:
+            self.aggregator.add_local_trained_result(
+                msg.sender_id, msg.get(md.KEY_MODEL_PARAMS),
+                float(msg.get(md.KEY_NUM_SAMPLES, 1.0)),
+            )
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self.params = self.aggregator.aggregate()
+            row = {"round": self.round_idx}
+            if self.eval_fn is not None:
+                row.update(self.eval_fn(self.params, self.round_idx))
+            self.history.append(row)
+            recorder.log(row)
+            self.round_idx += 1
+            if self.round_idx >= self.num_rounds:
+                self._finish()
+                return
+            self.round_clients = self._select_clients(self.round_idx)
+            self.aggregator.reset(self.round_clients)
+            for cid in self.round_clients:
+                m = Message(md.S2C_SYNC_MODEL, 0, cid)
+                m.add(md.KEY_MODEL_PARAMS, self.params)
+                m.add(md.KEY_ROUND, self.round_idx)
+                self.comm.send_message(m)
+
+    def _finish(self) -> None:
+        for cid in self.client_ids:
+            self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+        self.done.set()
+        self.comm.stop()
+
+    def run(self, background: bool = False) -> None:
+        self.comm.run(background=background)

@@ -1,0 +1,86 @@
+"""Silo-side trainer — the ClientTrainer/TrainerDistAdapter analog.
+
+(reference: cross_silo/client/fedml_trainer.py:66-77 FedMLTrainer.train runs
+the torch ClientTrainer; fedml_trainer_dist_adapter.py:9 wraps it with DDP for
+hierarchical silos, process_group_manager.py:8 builds the NCCL/Gloo group.)
+
+TPU design: a silo is a host + its TPU slice. "DDP inside the silo" becomes
+data parallelism over a local `jax.sharding.Mesh` — the batch is sharded over
+the mesh's `data` axis inside one jitted train step; XLA inserts the gradient
+all-reduce (the NCCL allreduce equivalent) automatically. No process groups,
+no torchrun env: the mesh IS the process group.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.algorithm import local_sgd, make_batch_indices, make_client_optimizer
+from ..config import TrainArgs
+
+Pytree = Any
+
+
+class SiloTrainer:
+    """Local trainer over host-resident numpy shards; the hot loop is the
+    same jitted lax.scan local_sgd the simulator uses."""
+
+    def __init__(self, apply_fn, t: TrainArgs, x: np.ndarray, y: np.ndarray,
+                 mesh: Optional[Mesh] = None, data_axis: str = "data",
+                 seed: int = 0):
+        self.apply_fn = apply_fn
+        self.t = t
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if mesh is not None:
+            # intra-silo data parallelism: pad the shard to the axis size
+            d = int(np.prod([mesh.shape[a] for a in (data_axis,)]))
+            pad = (-x.shape[0]) % d
+            if pad:
+                x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                y = np.concatenate([y, np.zeros((pad,), y.dtype)])
+                self._mask = np.concatenate(
+                    [np.ones(x.shape[0] - pad, np.float32), np.zeros(pad, np.float32)]
+                )
+            else:
+                self._mask = np.ones(x.shape[0], np.float32)
+            sh = NamedSharding(mesh, P(data_axis))
+            self.x = jax.device_put(jnp.asarray(x), sh)
+            self.y = jax.device_put(jnp.asarray(y), sh)
+            self.mask = jax.device_put(jnp.asarray(self._mask), sh)
+        else:
+            self.x, self.y = jnp.asarray(x), jnp.asarray(y)
+            self.mask = jnp.ones(x.shape[0], jnp.float32)
+        self.n_samples = int(np.sum(np.asarray(self.mask)))
+        self.opt = make_client_optimizer(
+            t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
+        )
+        self.seed = seed
+        self._jit_train = jax.jit(self._train_impl)
+
+    def _train_impl(self, params, rng):
+        shard = {"x": self.x, "y": self.y, "mask": self.mask}
+        idx = make_batch_indices(rng, self.x.shape[0], self.t.batch_size,
+                                 self.t.epochs)
+        new_params, metrics, _steps = local_sgd(
+            self.apply_fn, params, shard, idx, self.opt
+        )
+        return new_params, metrics
+
+    def train(self, params_np: Pytree, round_idx: int):
+        """(params numpy pytree) -> (new params numpy pytree, n, metrics) —
+        the ClientTrainer.train contract (reference: client_trainer.py:52)."""
+        params = jax.tree.map(jnp.asarray, params_np)
+        rng = jax.random.fold_in(jax.random.key(self.seed), round_idx)
+        new_params, m = self._jit_train(params, rng)
+        out = jax.tree.map(np.asarray, jax.device_get(new_params))
+        cnt = float(m.count)
+        metrics = {
+            "train_loss": float(m.loss_sum) / max(cnt, 1.0),
+            "train_acc": float(m.correct) / max(cnt, 1.0),
+        }
+        return out, self.n_samples, metrics

@@ -1,0 +1,141 @@
+"""Heterogeneity-aware workload scheduling (the "Parrot" scheduler).
+
+(reference: core/schedule/ — linear runtime fit t_sample_fit
+runtime_estimate.py:16, DP makespan scheduler SeqTrainScheduler.DP_schedule
+seq_train_scheduler.py:165, wired from the fedavg_seq aggregator
+simulation/mpi/fedavg_seq/FedAVGAggregator.py:126-187: uniform split for the
+first rounds, then fit per-(gpu, client) runtime and rebalance.)
+
+TPU context: inside one pod, SPMD padding makes per-chip client steps
+shape-identical, so scheduling matters at the *host/silo* tier — assigning
+clients with heterogeneous data sizes to silos/hosts (or choosing scan-group
+membership so shape buckets balance). The estimator/scheduler math is
+host-side pure Python either way and is kept API-compatible.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def linear_fit(x, y):
+    """Degree-1 polyfit + mean relative error (reference:
+    runtime_estimate.py:4-14)."""
+    z = np.polyfit(x, y, 1)
+    p = np.poly1d(z)
+    yv = p(x)
+    err = float(np.mean(np.abs(yv - y) / np.maximum(np.abs(y), 1e-12)))
+    return z, p, yv, err
+
+
+class RuntimeEstimator:
+    """Per-(worker, client) runtime history -> per-worker linear cost model
+    (reference: t_sample_fit, runtime_estimate.py:16-120; recording site
+    record_client_runtime, FedAVGAggregator.py:111)."""
+
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+        self.history: dict[int, dict[int, list[float]]] = {
+            w: {} for w in range(num_workers)
+        }
+
+    def record(self, worker: int, client: int, runtime: float) -> None:
+        self.history[worker].setdefault(client, []).append(float(runtime))
+
+    def fit(self, data_sizes: dict[int, int], uniform_workers: bool = False):
+        """Fit runtime ~ a*num_samples + b per worker (or one global fit when
+        uniform_workers). Returns {worker: (a, b)}, {worker: rel_error}."""
+        params, errors = {}, {}
+        groups = [list(range(self.num_workers))] if uniform_workers else \
+            [[w] for w in range(self.num_workers)]
+        for group in groups:
+            xs, ys = [], []
+            for w in group:
+                for cid, times in self.history[w].items():
+                    xs += [data_sizes[cid]] * len(times)
+                    ys += times
+            if len(xs) < 2 or len(set(xs)) < 2:
+                ab, err = (0.0, float(np.mean(ys)) if ys else 1.0), float("inf")
+            else:
+                z, _, _, err = linear_fit(np.asarray(xs, float),
+                                          np.asarray(ys, float))
+                ab = (float(z[0]), float(z[1]))
+            for w in group:
+                params[w], errors[w] = ab, err
+        return params, errors
+
+    def predict(self, worker: int, num_samples: int,
+                params: dict[int, tuple]) -> float:
+        a, b = params[worker]
+        return a * num_samples + b
+
+
+def lpt_schedule(costs: np.ndarray, num_workers: int,
+                 speeds: np.ndarray | None = None) -> list[list[int]]:
+    """Longest-processing-time-first makespan scheduling of jobs with `costs`
+    onto `num_workers` (optionally speed-scaled) workers — the greedy
+    workhorse behind the reference's DP search (seq_train_scheduler.py:165
+    explores assignments; LPT is its 4/3-approximation with n log n cost)."""
+    speeds = np.ones(num_workers) if speeds is None else np.asarray(speeds, float)
+    order = np.argsort(-np.asarray(costs, float))
+    loads = np.zeros(num_workers)
+    out: list[list[int]] = [[] for _ in range(num_workers)]
+    for j in order:
+        w = int(np.argmin((loads + costs[j]) / speeds))
+        out[w].append(int(j))
+        loads[w] += costs[j] / speeds[w]
+    return out
+
+
+def dp_schedule(costs: np.ndarray, num_workers: int,
+                max_states: int = 200_000) -> list[list[int]]:
+    """Exact(ish) branch-and-prune makespan minimization for small instances
+    (reference: SeqTrainScheduler.assign_a_workload_serial/DP_schedule —
+    breadth-first expansion of assignment maps with cost pruning)."""
+    costs = np.asarray(costs, float)
+    n = len(costs)
+    # state key: SORTED load tuple (worker-permutation symmetric states are
+    # equivalent for makespan); value: (assignment, actual loads)
+    states: dict[tuple, tuple] = {(0.0,) * num_workers: ((), [0.0] * num_workers)}
+    order = list(np.argsort(-costs))
+    for j in order:
+        new: dict[tuple, tuple] = {}
+        for assign, loads in states.values():
+            for w in range(num_workers):
+                nl = list(loads)
+                nl[w] += costs[j]
+                key = tuple(sorted(nl))
+                if key not in new:
+                    new[key] = (assign + ((j, w),), nl)
+        items = sorted(new.items(), key=lambda kv: kv[0][-1])[:max_states]
+        states = dict(items)
+    _, (best_assign, _) = min(states.items(), key=lambda kv: kv[0][-1])
+    out: list[list[int]] = [[] for _ in range(num_workers)]
+    for j, w in best_assign:
+        out[w].append(j)
+    return out
+
+
+def generate_client_schedule(
+    round_clients: list[int], data_sizes: dict[int, int], num_workers: int,
+    estimator: RuntimeEstimator | None = None, round_idx: int = 0,
+    fit_after_round: int = 5, fit_error_threshold: float = 1.0,
+) -> list[list[int]]:
+    """Client → worker assignment for sequential simulation (reference:
+    generate_client_schedule, FedAVGAggregator.py:126-187: uniform chunks for
+    the first `fit_after_round` rounds, then runtime-fit LPT balancing if the
+    fit error is acceptable)."""
+    if estimator is None or round_idx < fit_after_round:
+        chunks = np.array_split(np.asarray(round_clients), num_workers)
+        return [c.tolist() for c in chunks]
+    params, errors = estimator.fit(data_sizes, uniform_workers=False)
+    if np.mean([e for e in errors.values()]) > fit_error_threshold:
+        chunks = np.array_split(np.asarray(round_clients), num_workers)
+        return [c.tolist() for c in chunks]
+    # speed per worker = 1/a (samples per second slope); cost per client = n_i
+    speeds = np.asarray([
+        1.0 / max(params[w][0], 1e-9) for w in range(num_workers)
+    ])
+    speeds = speeds / speeds.max()
+    costs = np.asarray([data_sizes[c] for c in round_clients], float)
+    sched = lpt_schedule(costs, num_workers, speeds)
+    return [[round_clients[j] for j in jobs] for jobs in sched]

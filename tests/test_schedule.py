@@ -1,0 +1,59 @@
+"""Scheduler (reference: core/schedule/ via fedavg_seq)."""
+import numpy as np
+
+from fedml_tpu.schedule import (
+    RuntimeEstimator, dp_schedule, generate_client_schedule, linear_fit,
+    lpt_schedule,
+)
+
+
+def test_linear_fit_recovers_slope():
+    x = np.arange(1, 20, dtype=float)
+    y = 3.0 * x + 2.0
+    z, p, yv, err = linear_fit(x, y)
+    assert abs(z[0] - 3.0) < 1e-6 and err < 1e-6
+
+
+def test_lpt_balances_makespan():
+    costs = np.array([10, 9, 8, 7, 6, 5, 4], float)
+    sched = lpt_schedule(costs, 3)
+    loads = [sum(costs[j] for j in jobs) for jobs in sched]
+    # OPT = 17; LPT guarantees (4/3 - 1/3m)·OPT ≈ 20.8
+    assert max(loads) <= 21
+    assert sorted(j for jobs in sched for j in jobs) == list(range(7))
+
+
+def test_lpt_respects_speeds():
+    costs = np.ones(8)
+    sched = lpt_schedule(costs, 2, speeds=np.array([3.0, 1.0]))
+    assert len(sched[0]) > len(sched[1])  # fast worker gets more
+
+
+def test_dp_schedule_optimal_small():
+    costs = np.array([4, 3, 3, 2], float)
+    sched = dp_schedule(costs, 2)
+    loads = [sum(costs[j] for j in jobs) for jobs in sched]
+    assert max(loads) == 6.0  # optimal split {4,2} {3,3}
+
+
+def test_estimator_fit_and_schedule():
+    est = RuntimeEstimator(num_workers=2)
+    sizes = {c: 10 * (c + 1) for c in range(6)}
+    # worker 0 twice as fast
+    for c in range(6):
+        est.record(0, c, 0.05 * sizes[c] + 0.1)
+        est.record(1, c, 0.10 * sizes[c] + 0.1)
+    params, errors = est.fit(sizes)
+    assert params[0][0] < params[1][0]
+    assert errors[0] < 0.05
+    sched = generate_client_schedule(list(range(6)), sizes, 2, est,
+                                     round_idx=10)
+    load0 = sum(sizes[c] for c in sched[0])
+    load1 = sum(sizes[c] for c in sched[1])
+    assert load0 > load1  # faster worker carries more data
+
+
+def test_uniform_schedule_before_fit():
+    sched = generate_client_schedule(list(range(7)), {c: 1 for c in range(7)},
+                                     3, None, round_idx=0)
+    assert sum(len(s) for s in sched) == 7
