@@ -38,30 +38,33 @@ class SymmetricTopologyManager:
 
 
 class AsymmetricTopologyManager:
-    """Directed ring: each node listens to `in_num` predecessors and pushes to
-    `out_num` successors (reference: asymmetric_topology_manager.py:7)."""
+    """Directed ring: each node listens to `in_num` predecessors; `out_num`
+    adds extra directed out-edges to further successors (reference:
+    asymmetric_topology_manager.py:7). Push and listen graphs are two views of
+    ONE matrix — out-neighbors of j are the rows that listen to j (transpose),
+    matching asymmetric_topology_manager.py:91-110 (out=row, in=column); a
+    push graph inconsistent with the mixing matrix would drop messages the
+    mixing step requires."""
 
     def __init__(self, n: int, in_num: int = 2, out_num: int = 1):
         self.n = n
         self.in_num = min(in_num, n - 1)
         self.out_num = min(out_num, n - 1)
-        # mixing (listen) matrix: row i averages over in_num predecessors
         W = np.eye(n)
         for i in range(n):
+            # row i listens to in_num predecessors
             for d in range(1, self.in_num + 1):
                 W[i, (i - d) % n] = 1.0
-        self.topology = W / W.sum(axis=1, keepdims=True)
-        # push graph: node i pushes to out_num successors (distinct from the
-        # listen graph — that asymmetry is the point of this manager)
-        P_out = np.zeros((n, n))
-        for i in range(n):
+            # extra directed push links: i → i+1..i+out_num (rows that listen
+            # to i); a no-op unless out_num exceeds in_num's implied coverage
             for d in range(1, self.out_num + 1):
-                P_out[i, (i + d) % n] = 1.0
-        self.out_topology = P_out
+                W[(i + d) % n, i] = 1.0
+        self.topology = W / W.sum(axis=1, keepdims=True)
 
     def get_in_neighbor_idx_list(self, node: int) -> list[int]:
         return [j for j in range(self.n)
                 if self.topology[node, j] > 0 and j != node]
 
     def get_out_neighbor_idx_list(self, node: int) -> list[int]:
-        return [j for j in range(self.n) if self.out_topology[node, j] > 0]
+        return [i for i in range(self.n)
+                if self.topology[i, node] > 0 and i != node]
