@@ -57,7 +57,8 @@ class FedDP:
     split, fedml_differential_privacy.py:73-88)."""
 
     def __init__(self, d: DPArgs, client_num_per_round: int,
-                 client_num_in_total: int, comm_round: int):
+                 client_num_in_total: int, comm_round: int,
+                 counts: Optional[np.ndarray] = None):
         self.args = d
         self.solution = (d.dp_solution_type or LDP).lower()
         self.m = client_num_per_round
@@ -65,16 +66,54 @@ class FedDP:
         self.T = comm_round
         self.enabled = bool(d.enable_dp)
         self.accountant: Optional[RDPAccountant] = None
+        # per-client sample counts drive two calibrations (see the reference's
+        # set_params_for_dp): NbAFL's down-link divisor is the MINIMUM local
+        # dataset size, and CDP's aggregate sensitivity depends on the largest
+        # normalized aggregation weight, not 1/m.
+        cts = None
+        if counts is not None:
+            cts = np.asarray(counts, np.float64)
+            cts = cts[cts > 0]
+        # without counts, fall back to m (the pre-counts behavior) rather than
+        # 1 — a divisor of 1 would inflate NbAFL down-link noise by orders of
+        # magnitude for callers of the counts-less from_config(cfg)
+        self.min_local_n = (
+            float(cts.min()) if cts is not None and cts.size else float(max(self.m, 1))
+        )
+        if cts is not None and cts.size and self.m > 1:
+            # worst case per-round weight fraction: heaviest client sampled
+            # together with the (m-1) lightest OTHER clients — its normalized
+            # weight is the largest any client's can be under sample-count
+            # weighting (the heaviest must be excluded from the companions)
+            srt = np.sort(cts)
+            lightest = srt[:-1][: self.m - 1].sum()
+            self.max_weight_frac = float(srt[-1] / max(srt[-1] + lightest, 1e-12))
+        else:
+            self.max_weight_frac = 1.0 / max(self.m, 1)
         if not self.enabled:
             return
         if d.mechanism_type.lower() == "gaussian":
             self._sigma = gaussian_sigma(d.epsilon, d.delta, d.sensitivity)
             self._noise = lambda rng, t, s: add_gaussian_noise(rng, t, s)
             q = min(1.0, self.m / max(self.n, 1))
-            self.accountant = RDPAccountant(
-                noise_multiplier=self._sigma / max(d.sensitivity, 1e-12),
-                sampling_rate=q, target_delta=d.delta,
-            )
+            # RDP accounting only where the noise/sensitivity ratio is known:
+            # - LDP: global-norm clip bounds the update at clipping_norm, and
+            #   _sigma is applied directly to it.
+            # - CDP: applied sigma and sensitivity both scale by the same
+            #   C*max_weight_frac factor, so the ratio is _sigma/sensitivity.
+            # - dp_clip adds NO noise (true epsilon is infinite) and NbAFL's
+            #   per-coordinate clip gives L2 sensitivity C*sqrt(dim), unknown
+            #   here — neither gets an accountant; their dp_epsilon stays NaN.
+            if self.solution == LDP:
+                self.accountant = RDPAccountant(
+                    noise_multiplier=self._sigma / max(d.clipping_norm, 1e-12),
+                    sampling_rate=q, target_delta=d.delta,
+                )
+            elif self.solution == CDP:
+                self.accountant = RDPAccountant(
+                    noise_multiplier=self._sigma / max(d.sensitivity, 1e-12),
+                    sampling_rate=q, target_delta=d.delta,
+                )
         else:
             self._sigma = laplace_scale(d.epsilon, d.sensitivity)
             self._noise = lambda rng, t, s: add_laplace_noise(rng, t, s)
@@ -108,21 +147,27 @@ class FedDP:
             return None
         d = self.args
         if self.solution == CDP:
-            # sensitivity of the weighted mean of norm-C updates is C/m —
-            # replace the mechanism's configured sensitivity with it (dividing
-            # it out first; multiplying _sigma directly would double-count)
+            # sensitivity of the sample-count-weighted mean of norm-C updates
+            # is C * max_i(w_i)/sum(w) — a heavy client's normalized weight can
+            # exceed 1/m, so the uniform C/m calibration would under-noise.
+            # (replace the mechanism's configured sensitivity by dividing it
+            # out first; multiplying _sigma directly would double-count)
             sigma = (self._sigma / max(d.sensitivity, 1e-12)) \
-                * d.clipping_norm / max(self.m, 1)
+                * d.clipping_norm * self.max_weight_frac
             return lambda agg, rng: self._noise(rng, agg, sigma)
         if self.solution == NBAFL:
-            # NbAFL.py:48-56: extra down-link noise only when T > sqrt(N)*L
+            # NbAFL.py:48-56: extra down-link noise only when T > sqrt(N)*L;
+            # the divisor m in the paper's sigma_d is the MINIMUM local
+            # dataset size (reference set_params_for_dp), typically far larger
+            # than clients-per-round — dividing by the latter over-scales the
+            # global noise by orders of magnitude.
             if self.T > np.sqrt(self.n) * self.m:
                 c_small = np.sqrt(2 * np.log(1.25 / d.delta))
                 scale_d = (
                     2 * c_small * d.clipping_norm
                     * np.sqrt(self.T**2 - self.m**2 * self.n)
                     / (max(self.n, 1) * d.epsilon)
-                ) / max(self.m, 1)
+                ) / max(self.min_local_n, 1.0)
                 return lambda agg, rng: self._noise(rng, agg, float(scale_d))
             return None
         return None
@@ -135,7 +180,7 @@ class FedDP:
         return self.accountant.get_epsilon() if self.accountant else float("nan")
 
 
-def from_config(cfg: Config) -> FedDP:
+def from_config(cfg: Config, counts: Optional[np.ndarray] = None) -> FedDP:
     t = cfg.train_args
     return FedDP(cfg.dp_args, t.client_num_per_round, t.client_num_in_total,
-                 t.comm_round)
+                 t.comm_round, counts=counts)

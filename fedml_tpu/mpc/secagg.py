@@ -15,6 +15,15 @@ Protocol (Bonawitz et al. 2017), the reference's cross-silo SecAgg kernel
 Host-side crypto (numpy mod-p); the masked vectors are ordinary int64 arrays
 that ride the normal comm layer. TPU note: masking/unmasking is elementwise
 add mod p — O(D) on CPU is fine; the heavy part (the sum) stays on device.
+
+SECURITY SCOPE: this module implements the *protocol structure* for
+simulation and testing, not production-grade cryptography. Key agreement is
+DH over the 31-bit field prime with generator 5 and the masks come from a
+non-cryptographic PRG (np.random.Philox) — trivially breakable by a real
+adversary. For real cross-silo deployments swap the `agree`/`prg_mask`
+primitives for X25519 key agreement + a keyed PRF (e.g. HKDF + ChaCha20)
+behind the same interface; the message flow and dropout recovery are
+unchanged by that substitution.
 """
 from __future__ import annotations
 

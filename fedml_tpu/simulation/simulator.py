@@ -95,7 +95,7 @@ class Simulator:
 
         # -------- plugins: security, DP, compression (SURVEY.md §2.5/§2.4)
         self.attacker, self.defender = sec_mod.from_config(cfg)
-        self.dp = dp_mod.from_config(cfg)
+        self.dp = dp_mod.from_config(cfg, counts=self.dataset.counts)
         comp = make_compression_transform(
             t.extra.get("compression", "none"),
             float(t.extra.get("compression_ratio", 0.05)),

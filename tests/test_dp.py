@@ -102,3 +102,33 @@ def test_nbafl_coord_clip():
     dp = from_config(_dp_cfg("nbafl"))
     noised = dp.client_transform()({"w": jnp.zeros((3,))}, jax.random.key(1))
     assert noised["w"].shape == (3,)  # clip + gaussian noise applied
+
+
+def test_cdp_sensitivity_uses_max_weight_fraction():
+    # skewed counts: heaviest client's normalized weight >> 1/m, so CDP must
+    # add MORE noise than the uniform C/m calibration would
+    skew = np.array([1000, 1, 1, 1, 1, 1, 1, 1, 1, 1])
+    dp_skew = from_config(_dp_cfg("cdp"), counts=skew)
+    dp_unif = from_config(_dp_cfg("cdp"), counts=np.full(10, 100))
+    assert dp_skew.max_weight_frac > 0.9
+    assert np.isclose(dp_unif.max_weight_frac, 0.25)  # m=4 uniform
+    big = {"w": jnp.zeros((20000,))}
+    std_skew = float(dp_skew.server_transform()(big, jax.random.key(0))["w"].std())
+    std_unif = float(dp_unif.server_transform()(big, jax.random.key(0))["w"].std())
+    assert std_skew > 3 * std_unif
+
+
+def test_nbafl_downlink_divisor_is_min_dataset_size():
+    cfg = Config.from_dict({
+        "train_args": {"client_num_in_total": 4, "client_num_per_round": 2,
+                       "comm_round": 100},  # T > sqrt(N)*L -> downlink noise on
+        "dp_args": {"enable_dp": True, "dp_solution_type": "nbafl",
+                    "epsilon": 0.9, "delta": 1e-5, "clipping_norm": 1.0},
+    })
+    dp_small = from_config(cfg, counts=np.array([10, 10, 10, 10]))
+    dp_large = from_config(cfg, counts=np.array([1000, 1000, 1000, 1000]))
+    assert dp_small.min_local_n == 10 and dp_large.min_local_n == 1000
+    big = {"w": jnp.zeros((20000,))}
+    std_s = float(dp_small.server_transform()(big, jax.random.key(0))["w"].std())
+    std_l = float(dp_large.server_transform()(big, jax.random.key(0))["w"].std())
+    assert np.isclose(std_s / std_l, 100.0, rtol=0.1)
