@@ -119,6 +119,52 @@ def qsgd_compress(update: Pytree, bits: int, rng: jax.Array) -> Pytree:
 COMPRESSORS = ("none", "topk", "eftopk", "randk", "quantize", "qsgd")
 
 
+def wrap_algorithm_with_eftopk(alg, ratio: float,
+                               pre_transform: Optional[Callable] = None):
+    """Thread EF-TopK's per-client residual through the round engine's
+    client-state mechanism: the wrapped algorithm's client state becomes
+    {"inner": <original state>, "residual": <params-shaped error carry>} and
+    every update is compensated + sparsified before aggregation (reference:
+    EFTopKCompressor, utils/compression.py:139-173 — there the residual lives
+    in a host-side dict per tensor name; here it is device-resident state,
+    stacked [num_clients, ...] and scattered back each round).
+
+    Works for algorithms whose update pytree is params-shaped (FedAvg, FedProx,
+    FedOpt, FedDyn). Structured-payload algorithms (FedNova's {d, tau},
+    SCAFFOLD's {delta, dc}, Mime's {delta, g}) are rejected: compressing the
+    control-variate/statistics legs would break their server algebra.
+    """
+    import dataclasses as _dc
+
+    if alg.name in ("FedNova", "SCAFFOLD", "Mime"):
+        raise ValueError(
+            f"eftopk cannot wrap {alg.name}: its update payload is a "
+            "structured dict, not a params-shaped delta; use 'topk'/'qsgd' "
+            "on a params-delta algorithm instead"
+        )
+    inner_init = alg.client_state_init
+
+    def state_init(params):
+        return {
+            "inner": inner_init(params) if inner_init is not None else jnp.zeros(()),
+            "residual": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def client_update(bcast, shard, cstate, rng):
+        upd, new_inner, met = alg.client_update(bcast, shard, cstate["inner"], rng)
+        if pre_transform is not None:
+            # client-side defenses run BEFORE sparsification, same pipeline
+            # position as with the stateless compressors
+            upd = pre_transform(upd, jax.random.fold_in(rng, 0x9A))
+        sparse, new_res = eftopk_compress(upd, cstate["residual"], ratio)
+        return sparse, {"inner": new_inner, "residual": new_res}, met
+
+    return _dc.replace(
+        alg, name=alg.name + "+eftopk", client_update=client_update,
+        client_state_init=state_init,
+    )
+
+
 def make_compression_transform(
     name: str, ratio: float = 0.05, bits: int = 8
 ) -> Optional[Callable[[Pytree, jax.Array], Pytree]]:

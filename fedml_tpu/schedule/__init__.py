@@ -86,6 +86,31 @@ def lpt_schedule(costs: np.ndarray, num_workers: int,
     return out
 
 
+def balanced_lpt(costs: np.ndarray, num_workers: int) -> list[list[int]]:
+    """LPT with a cardinality constraint: every worker receives exactly
+    len(costs)/num_workers jobs. This is the shape SPMD placement needs —
+    shard_map splits the sampled-client axis into equal contiguous blocks per
+    chip, so the schedule can only permute clients among fixed-size slots
+    (unlike the reference's MPI workers, which take variable-length client
+    lists — FedAVGAggregator.py:126-187)."""
+    costs = np.asarray(costs, float)
+    n = len(costs)
+    if n % num_workers:
+        raise ValueError(f"{n} jobs not divisible by {num_workers} workers")
+    slots = n // num_workers
+    order = np.argsort(-costs)
+    loads = np.zeros(num_workers)
+    fill = np.zeros(num_workers, int)
+    out: list[list[int]] = [[] for _ in range(num_workers)]
+    for j in order:
+        open_ws = np.flatnonzero(fill < slots)
+        w = int(open_ws[np.argmin(loads[open_ws])])
+        out[w].append(int(j))
+        loads[w] += costs[j]
+        fill[w] += 1
+    return out
+
+
 def dp_schedule(costs: np.ndarray, num_workers: int,
                 max_states: int = 200_000) -> list[list[int]]:
     """Exact(ish) branch-and-prune makespan minimization for small instances

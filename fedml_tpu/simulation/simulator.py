@@ -27,6 +27,7 @@ from ..data import loader as data_loader
 from ..models import hub as model_hub
 from ..ops import tree as tu
 from ..parallel.mesh import make_mesh
+from .. import schedule as lpt_sched
 from ..parallel.round import build_round_fn, shard_fed_data
 from ..utils.events import recorder
 
@@ -96,15 +97,30 @@ class Simulator:
         # -------- plugins: security, DP, compression (SURVEY.md §2.5/§2.4)
         self.attacker, self.defender = sec_mod.from_config(cfg)
         self.dp = dp_mod.from_config(cfg, counts=self.dataset.counts)
-        comp = make_compression_transform(
-            t.extra.get("compression", "none"),
-            float(t.extra.get("compression_ratio", 0.05)),
-            int(t.extra.get("quantize_bits", 8)),
-        )
-        post_update = _compose(
-            self.defender.update_transform(), comp, self.dp.client_transform()
-        )
+        comp_name = str(t.extra.get("compression", "none")).lower()
+        comp_ratio = float(t.extra.get("compression_ratio", 0.05))
+        if comp_name == "eftopk":
+            # error feedback carries per-client residual state — it rides the
+            # engine's client-state mechanism, not the stateless hook. The
+            # defender's update transform moves inside the wrapper (before
+            # sparsification) so the pipeline order matches every other
+            # compressor: defender -> compress -> dp.
+            from ..compression import wrap_algorithm_with_eftopk
+            self.alg = wrap_algorithm_with_eftopk(
+                self.alg, comp_ratio,
+                pre_transform=self.defender.update_transform(),
+            )
+            post_update = _compose(self.dp.client_transform())
+        else:
+            comp = make_compression_transform(
+                comp_name, comp_ratio, int(t.extra.get("quantize_bits", 8)),
+            )
+            post_update = _compose(
+                self.defender.update_transform(), comp, self.dp.client_transform()
+            )
         agg_full = sec_mod.build_server_pipeline(self.attacker, self.defender)
+        from ..core.algorithm import FULL as _FULL
+        self._use_full = agg_full is not None or self.alg.agg_mode == _FULL
         dp_server = self.dp.server_transform()
         dfs_post = self.defender.postprocess_agg()
         post_agg = None
@@ -116,6 +132,7 @@ class Simulator:
                     agg = dp_server(agg, jax.random.fold_in(ctx["rng"], 0xD9))
                 return agg
 
+        self._schedule = bool(t.extra.get("heterogeneity_schedule", True))
         group = int(t.extra.get("clients_per_device_parallel", 1))
         self.round_fn = build_round_fn(
             self.alg, self.mesh, group_size=group,
@@ -179,7 +196,12 @@ class Simulator:
 
     def _pad_ids(self, ids: np.ndarray):
         """Pad sampled ids to a multiple of the mesh size with zero-weight
-        duplicates so shard_map shapes stay static."""
+        duplicates so shard_map shapes stay static, then balance per-device
+        load with the Parrot scheduler (reference:
+        FedAVGAggregator.generate_client_schedule, fedavg_seq:126-187 —
+        uniform chunks would put all heavy clients on one chip when the
+        dataset is skewed; balanced LPT permutes clients among the equal-size
+        device slots so per-chip useful-sample load is even)."""
         weights = np.asarray(self.counts)[ids].astype(np.float32)
         if self.mesh is None:
             return ids, weights
@@ -192,6 +214,16 @@ class Simulator:
             # persistent state (SCAFFOLD c_i / FedDyn h_i) on unsampled rounds
             ids = np.concatenate([ids, np.full(pad, ids[0], np.int32)])
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        # FULL-mode aggregation slices the real clients back out as a prefix
+        # (round.py call_full, num_real_clients); a permutation that moves pad
+        # duplicates into that prefix would silently drop real updates — skip
+        # scheduling whenever both padding and FULL hooks are in play.
+        schedulable = pad == 0 or not self._use_full
+        if self._schedule and schedulable and len(ids) > d \
+                and len(np.unique(weights)) > 1:
+            blocks = lpt_sched.balanced_lpt(weights, d)
+            perm = np.concatenate([np.asarray(b, int) for b in blocks])
+            ids, weights = ids[perm], weights[perm]
         return ids, weights
 
     def run_round(self, round_idx: int) -> dict:

@@ -57,3 +57,90 @@ def test_uniform_schedule_before_fit():
     sched = generate_client_schedule(list(range(7)), {c: 1 for c in range(7)},
                                      3, None, round_idx=0)
     assert sum(len(s) for s in sched) == 7
+
+
+def test_balanced_lpt_equal_slots_and_better_makespan():
+    from fedml_tpu.schedule import balanced_lpt
+    # skewed: uniform contiguous chunks put both heavy jobs on worker 0
+    costs = np.array([100, 90, 1, 1, 1, 1, 1, 1], float)
+    sched = balanced_lpt(costs, 4)
+    assert all(len(s) == 2 for s in sched)
+    loads = [sum(costs[j] for j in jobs) for jobs in sched]
+    uniform = [costs[i * 2:(i + 1) * 2].sum() for i in range(4)]
+    assert max(loads) < max(uniform)  # 101 vs 190
+    assert sorted(j for jobs in sched for j in jobs) == list(range(8))
+
+
+def test_simulator_schedules_heterogeneous_clients_across_devices():
+    """The Parrot schedule wired into the mesh path: skewed per-client counts
+    must not land on one chip; the round still computes the same global model
+    as the unscheduled placement (aggregation is placement-invariant)."""
+    import jax
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+
+    def cfg(schedule_on):
+        return fedml_tpu.init(config={
+            "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                          "partition_alpha": 0.1},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg", "client_num_in_total": 16,
+                "client_num_per_round": 16, "comm_round": 2, "epochs": 1,
+                "batch_size": 16, "learning_rate": 0.1,
+                "heterogeneity_schedule": schedule_on,
+            },
+            "comm_args": {"backend": "xla"},
+        })
+
+    sim = Simulator(cfg(True))
+    assert sim.mesh is not None
+    sampled = sim.sample_clients(0)
+    ids, w = sim._pad_ids(sampled)
+    d = sim.mesh.devices.size
+    s = len(ids) // d
+    block_loads = [w[i * s:(i + 1) * s].sum() for i in range(d)]
+    # the unscheduled placement is the sampled order (sorted ids) chunked
+    w_u = np.asarray(sim.counts)[sampled]
+    uniform_loads = [w_u[i * s:(i + 1) * s].sum() for i in range(d)]
+    assert sorted(ids.tolist()) == sorted(sampled.tolist())  # a permutation
+    assert max(block_loads) <= max(uniform_loads) + 1e-6
+
+    sim.run(2)
+    sim_off = Simulator(cfg(False))
+    sim_off.run(2)
+    for a, b in zip(jax.tree.leaves(jax.device_get(sim.server_state.params)),
+                    jax.tree.leaves(jax.device_get(sim_off.server_state.params))):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_schedule_skipped_when_padding_meets_full_mode():
+    """FULL-mode hooks slice real clients as a prefix; with pad duplicates the
+    schedule permutation must be skipped so the prefix invariant holds."""
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.1},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg", "client_num_in_total": 20,
+            "client_num_per_round": 10, "comm_round": 1, "epochs": 1,
+            "batch_size": 16, "learning_rate": 0.1,
+            "heterogeneity_schedule": True,
+        },
+        "security_args": {"enable_defense": True, "defense_type": "krum",
+                          "byzantine_client_num": 2},
+        "comm_args": {"backend": "xla"},
+    })
+    sim = Simulator(cfg)
+    assert sim.mesh is not None and sim._use_full
+    sampled = sim.sample_clients(0)
+    ids, w = sim._pad_ids(sampled)
+    # 10 real + 6 pads: real clients must remain the prefix, pads the suffix
+    assert len(ids) == 16
+    np.testing.assert_array_equal(ids[:10], sampled)
+    assert np.all(w[10:] == 0.0)
+    m = sim.run_round(0)
+    assert np.isfinite(m["train_loss"])
