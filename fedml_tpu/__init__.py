@@ -59,3 +59,10 @@ def run_simulation(cfg: Config, dataset=None, model=None):
     from .simulation.simulator import run_simulation as _run
 
     return _run(cfg, dataset, model)
+
+
+def run_async_simulation(cfg: Config, dataset=None, model=None):
+    """Staleness-weighted async FL (reference: simulation/mpi/async_fedavg/)."""
+    from .simulation.async_simulator import run_async_simulation as _run
+
+    return _run(cfg, dataset, model)

@@ -46,6 +46,9 @@ class FedClientManager:
         out.add(md.KEY_MODEL_PARAMS, new_params)
         out.add(md.KEY_NUM_SAMPLES, n)
         out.add(md.KEY_METRICS, metrics)
+        # echo the round so a straggler's result can't leak into a later
+        # round after a timeout-closed aggregation (server checks KEY_ROUND)
+        out.add(md.KEY_ROUND, round_idx)
         self.comm.send_message(out)
 
     def _on_init(self, msg: Message) -> None:

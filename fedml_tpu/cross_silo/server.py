@@ -8,10 +8,18 @@ add_local_trained_result/check_whether_all_receive/aggregate.)
 
 Aggregation runs on device: stacked numpy updates → tree_weighted_mean (or
 the security pipeline's robust aggregate) in one jit call.
+
+Beyond the reference: timeout-based partial aggregation. The reference's sync
+server waits forever for every selected client
+(fedml_aggregator.check_whether_all_receive, :68-75 — its only dropout story
+is the separate async_fedavg runtime); here `round_timeout` + `quorum_frac`
+let the round close on a quorum after a deadline, and stragglers simply
+rejoin the next selection.
 """
 from __future__ import annotations
 
 import logging
+import math
 import threading
 from typing import Any, Callable, Optional
 
@@ -61,14 +69,27 @@ class FedAggregator:
 
 
 class FedServerManager:
-    """(reference: FedMLServerManager, fedml_server_manager.py:22-246)"""
+    """(reference: FedMLServerManager, fedml_server_manager.py:22-246)
+
+    round_timeout: seconds to wait for selected clients before attempting a
+    partial aggregate. None (default) = reference behavior, wait forever.
+    quorum_frac: fraction of selected clients that must have reported for a
+    timed-out round to close (ceil; at least 1). Below quorum the timer
+    re-arms. Dropped clients stay in `client_ids` and rejoin later rounds.
+    postprocess_agg_fn: (params, round_idx) -> params applied after
+    aggregation — the on_after_aggregation hook site (reference:
+    core/alg_frame/server_aggregator.py:79-83; central-DP noise lands here).
+    """
 
     def __init__(self, comm: FedCommManager, client_ids: list[int],
                  init_params: Pytree, num_rounds: int,
                  aggregate_fn: Optional[Callable] = None,
                  eval_fn: Optional[Callable[[Pytree, int], dict]] = None,
                  client_num_per_round: Optional[int] = None,
-                 sample_seed: int = 0):
+                 sample_seed: int = 0,
+                 round_timeout: Optional[float] = None,
+                 quorum_frac: float = 1.0,
+                 postprocess_agg_fn: Optional[Callable] = None):
         self.comm = comm
         self.client_ids = list(client_ids)
         self.m = client_num_per_round or len(self.client_ids)
@@ -78,11 +99,16 @@ class FedServerManager:
         self.aggregator = FedAggregator(aggregate_fn)
         self.eval_fn = eval_fn
         self.sample_seed = sample_seed
+        self.round_timeout = round_timeout
+        self.quorum_frac = float(quorum_frac)
+        self.postprocess_agg_fn = postprocess_agg_fn
         self.client_online: dict[int, bool] = {}
         self.is_initialized = False
         self.done = threading.Event()
         self.history: list[dict] = []
+        self.dropped_log: list[tuple[int, list[int]]] = []  # (round, dropped ids)
         self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
 
         comm.register_message_receive_handler(
             md.CONNECTION_IS_READY, self._on_connection_ready)
@@ -104,7 +130,10 @@ class FedServerManager:
         if self.is_initialized:
             return
         self.round_clients = self._select_clients(0)
-        for cid in self.round_clients:
+        # status-check EVERY client, not just round 0's selection — clients
+        # selected in later rounds must be registered online too (the round-1
+        # weakness: unselected clients never got a check)
+        for cid in self.client_ids:
             self.comm.send_message(
                 Message(md.S2C_CHECK_CLIENT_STATUS, 0, cid))
 
@@ -127,34 +156,99 @@ class FedServerManager:
             m.add(md.KEY_MODEL_PARAMS, self.params)
             m.add(md.KEY_ROUND, self.round_idx)
             self.comm.send_message(m)
+        self._arm_timer()
+
+    # ------------------------------------------------------ dropout handling
+    def _arm_timer(self) -> None:
+        if self.round_timeout is None:
+            return
+        self._cancel_timer()
+        # bind the timer to the round it guards: a timer that fires while its
+        # round completes would otherwise run against the NEXT round's state
+        # (cancel() is a no-op on an already-fired Timer)
+        t = threading.Timer(
+            self.round_timeout, self._on_round_timeout, args=(self.round_idx,))
+        t.daemon = True
+        t.start()
+        self._timer = t
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _quorum(self) -> int:
+        n = len(self.aggregator.expected)
+        return max(1, math.ceil(self.quorum_frac * n))
+
+    def _on_round_timeout(self, armed_round: int) -> None:
+        with self._lock:
+            if self.done.is_set() or armed_round != self.round_idx:
+                return  # stale timer from an already-completed round
+            received = len(self.aggregator.results)
+            if received >= self._quorum():
+                dropped = sorted(self.aggregator.expected
+                                 - set(self.aggregator.results))
+                if dropped:
+                    log.warning("round %d: aggregating %d/%d, dropped %s",
+                                self.round_idx, received,
+                                len(self.aggregator.expected), dropped)
+                    self.dropped_log.append((self.round_idx, dropped))
+                self._complete_round()
+            else:
+                # below quorum: keep waiting (re-arm), matching the spirit of
+                # the reference's wait-for-all rather than failing the run
+                self._arm_timer()
 
     def _on_model_from_client(self, msg: Message) -> None:
         with self._lock:
+            # a straggler's model from a closed round must not leak into the
+            # current one — clients echo the round index they trained on;
+            # a missing echo is rejected rather than assumed current (a
+            # defaulted value would bypass exactly this guard)
+            msg_round = msg.get(md.KEY_ROUND)
+            if msg_round is None:
+                log.warning("dropping C2S_SEND_MODEL from %s without %s",
+                            msg.sender_id, md.KEY_ROUND)
+                return
+            if int(msg_round) != self.round_idx or \
+                    msg.sender_id not in self.aggregator.expected:
+                return
             self.aggregator.add_local_trained_result(
                 msg.sender_id, msg.get(md.KEY_MODEL_PARAMS),
                 float(msg.get(md.KEY_NUM_SAMPLES, 1.0)),
             )
             if not self.aggregator.check_whether_all_receive():
                 return
-            self.params = self.aggregator.aggregate()
-            row = {"round": self.round_idx}
-            if self.eval_fn is not None:
-                row.update(self.eval_fn(self.params, self.round_idx))
-            self.history.append(row)
-            recorder.log(row)
-            self.round_idx += 1
-            if self.round_idx >= self.num_rounds:
-                self._finish()
-                return
-            self.round_clients = self._select_clients(self.round_idx)
-            self.aggregator.reset(self.round_clients)
-            for cid in self.round_clients:
-                m = Message(md.S2C_SYNC_MODEL, 0, cid)
-                m.add(md.KEY_MODEL_PARAMS, self.params)
-                m.add(md.KEY_ROUND, self.round_idx)
-                self.comm.send_message(m)
+            self._complete_round()
+
+    def _complete_round(self) -> None:
+        """Aggregate what's in the pool and advance. Caller holds the lock."""
+        self._cancel_timer()
+        self.params = self.aggregator.aggregate()
+        if self.postprocess_agg_fn is not None:
+            self.params = self.postprocess_agg_fn(self.params, self.round_idx)
+        row = {"round": self.round_idx,
+               "n_received": len(self.aggregator.results)}
+        if self.eval_fn is not None:
+            row.update(self.eval_fn(self.params, self.round_idx))
+        self.history.append(row)
+        recorder.log(row)
+        self.round_idx += 1
+        if self.round_idx >= self.num_rounds:
+            self._finish()
+            return
+        self.round_clients = self._select_clients(self.round_idx)
+        self.aggregator.reset(self.round_clients)
+        for cid in self.round_clients:
+            m = Message(md.S2C_SYNC_MODEL, 0, cid)
+            m.add(md.KEY_MODEL_PARAMS, self.params)
+            m.add(md.KEY_ROUND, self.round_idx)
+            self.comm.send_message(m)
+        self._arm_timer()
 
     def _finish(self) -> None:
+        self._cancel_timer()
         for cid in self.client_ids:
             self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
         self.done.set()
