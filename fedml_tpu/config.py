@@ -83,6 +83,10 @@ class TrainArgs:
     server_optimizer: str = "sgd"
     server_lr: float = 1.0
     server_momentum: float = 0.0
+    # Mixed-precision compute: "float32" or "bfloat16". bf16 keeps params and
+    # optimizer accumulation in f32 but runs matmuls/convs on the MXU in bf16
+    # (the reference has no equivalent — torch AMP is never used in its FL loops).
+    compute_dtype: str = "float32"
     # FedProx / FedDyn / Mime hyper-params (explicit zeros are honored)
     fedprox_mu: float = 0.01
     feddyn_alpha: float = 0.01

@@ -120,10 +120,16 @@ class FedServerManager:
     # --- selection (reference: fedml_aggregator.client_selection — seeded by
     # round, matching fedavg_api.py:127-135)
     def _select_clients(self, round_idx: int) -> list[int]:
-        if self.m >= len(self.client_ids):
-            return list(self.client_ids)
+        # sample from clients that have reported ONLINE (the init status check
+        # goes to every client, so later rounds can select any live one);
+        # before any status arrives — round 0 — fall back to the full list
+        pool = [c for c in self.client_ids if self.client_online.get(c, False)]
+        if len(pool) < self.m:
+            pool = list(self.client_ids)
+        if self.m >= len(pool):
+            return sorted(pool)
         rng = np.random.RandomState(self.sample_seed + round_idx)
-        return sorted(rng.choice(self.client_ids, self.m, replace=False).tolist())
+        return sorted(rng.choice(pool, self.m, replace=False).tolist())
 
     # ------------------------------------------------------------- handlers
     def _on_connection_ready(self, msg: Message) -> None:
@@ -252,7 +258,10 @@ class FedServerManager:
         for cid in self.client_ids:
             self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
         self.done.set()
-        self.comm.stop()
+        # callers hold self._lock; comm.stop() joins the receive thread, which
+        # may itself be blocked on the lock in a handler — stop from a fresh
+        # thread so the join can't deadlock/stall against our lock
+        threading.Thread(target=self.comm.stop, daemon=True).start()
 
     def run(self, background: bool = False) -> None:
         self.comm.run(background=background)

@@ -29,6 +29,10 @@ class FedDataset:
     y_test: np.ndarray         # [num_test]
     num_classes: int
     client_class_stats: Optional[dict] = None
+    # True when the loader fell back to the synthetic generator (no real data
+    # on disk). Benchmarks and reports must surface this — accuracy on
+    # synthetic data is a smoke signal, not evidence of parity.
+    synthetic: bool = False
 
     @property
     def num_clients(self) -> int:

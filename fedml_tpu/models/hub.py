@@ -137,6 +137,30 @@ def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
     return MODELS.get(model_name)(num_classes=num_classes, **kwargs)
 
 
+def mixed_precision_apply(apply_fn, compute_dtype: str):
+    """Wrap a flax apply fn for mixed-precision compute.
+
+    Params stay float32 (master weights); they and floating inputs are cast to
+    `compute_dtype` (bfloat16 on TPU) at the apply boundary, so XLA schedules
+    matmuls/convs on the MXU in bf16 while the optimizer accumulates in f32 —
+    the cast is linear, so its transpose casts gradients back to f32
+    automatically. Logits are returned in f32 so the loss/softmax is exact.
+    """
+    dtype = jnp.dtype(compute_dtype)
+    if dtype == jnp.float32:
+        return apply_fn
+
+    def cast_leaf(v):
+        return v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating) else v
+
+    def wrapped(variables, x, *args, **kwargs):
+        variables = jax.tree.map(cast_leaf, variables)
+        out = apply_fn(variables, cast_leaf(jnp.asarray(x)), *args, **kwargs)
+        return jax.tree.map(lambda o: o.astype(jnp.float32), out)
+
+    return wrapped
+
+
 def init_params(module: nn.Module, input_shape: tuple, rng: jax.Array, dtype=jnp.float32):
     dummy = (
         jnp.zeros((1,) + tuple(input_shape), dtype=jnp.int32)

@@ -87,7 +87,8 @@ class AsyncSimulator:
         opt = make_client_optimizer(
             t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay)
         shard_size = self.dataset.x_train.shape[1]
-        apply_fn = self.model.apply
+        from ..models.hub import mixed_precision_apply
+        apply_fn = mixed_precision_apply(self.model.apply, t.compute_dtype)
 
         def train_one(params, cid, rng_):
             shard = jax.tree.map(lambda a: a[cid], self.data)

@@ -89,8 +89,11 @@ class Simulator:
         else:
             self.mesh = None
 
+        self.apply_fn = model_hub.mixed_precision_apply(
+            self.model.apply, t.compute_dtype
+        )
         self.alg = build_algorithm(
-            t.federated_optimizer, self.model.apply, t,
+            t.federated_optimizer, self.apply_fn, t,
             t.client_num_in_total, t.client_num_per_round,
         )
 
@@ -182,7 +185,7 @@ class Simulator:
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
         )
         self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
-        self._eval = jax.jit(eval_step_fn(self.model.apply))
+        self._eval = jax.jit(eval_step_fn(self.apply_fn))
         self.history: list[dict] = []
 
     # reference parity: np seeded by round index (fedavg_api.py:127-135)

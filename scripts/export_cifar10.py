@@ -1,0 +1,101 @@
+"""Export CIFAR-10 to the framework's npz cache format.
+
+The runtime's data hub reads `<data_cache_dir>/cifar10.npz` with keys
+x_train/y_train/x_test/y_test (fedml_tpu/data/loader.py:_npz_dataset). This
+script produces that file from whatever CIFAR-10 source is available on the
+machine — torchvision, tf.keras' cache, or the original python pickle batches
+(cifar-10-batches-py) — so air-gapped hosts can be provisioned by copying one
+file. Reference loader being replaced: /root/reference/python/fedml/data/
+cifar10/data_loader.py:117 (torchvision download + Dirichlet partition; here
+partitioning happens at load time inside the framework instead).
+
+Usage: python scripts/export_cifar10.py [--out DIR] [--src DIR]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def from_batches_py(src: Path):
+    """Original CIFAR-10 python pickle format (cifar-10-batches-py/)."""
+    d = src / "cifar-10-batches-py"
+    if not d.is_dir():
+        return None
+    xs, ys = [], []
+    for i in range(1, 6):
+        with open(d / f"data_batch_{i}", "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        xs.append(b[b"data"])
+        ys.append(b[b"labels"])
+    with open(d / "test_batch", "rb") as f:
+        b = pickle.load(f, encoding="bytes")
+    to_img = lambda a: a.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return (
+        to_img(np.concatenate(xs)), np.concatenate(ys).astype(np.int64),
+        to_img(b[b"data"]), np.asarray(b[b"labels"], np.int64),
+    )
+
+
+def from_torchvision(src: Path):
+    try:
+        from torchvision.datasets import CIFAR10
+    except ImportError:
+        return None
+    try:
+        tr = CIFAR10(str(src), train=True, download=False)
+        te = CIFAR10(str(src), train=False, download=False)
+    except RuntimeError:
+        return None
+    return (
+        np.asarray(tr.data), np.asarray(tr.targets, np.int64),
+        np.asarray(te.data), np.asarray(te.targets, np.int64),
+    )
+
+
+def from_keras():
+    cache = Path(os.path.expanduser("~/.keras/datasets/cifar-10-batches-py.tar.gz"))
+    if not cache.is_file():
+        return None
+    from tensorflow.keras.datasets import cifar10
+
+    (xt, yt), (xv, yv) = cifar10.load_data()
+    return xt, yt.ravel().astype(np.int64), xv, yv.ravel().astype(np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="~/fedml_data")
+    ap.add_argument("--src", default="~/fedml_data", help="dir holding raw CIFAR-10")
+    args = ap.parse_args()
+    src = Path(os.path.expanduser(args.src))
+    out = Path(os.path.expanduser(args.out))
+    out.mkdir(parents=True, exist_ok=True)
+
+    for fn in (lambda: from_batches_py(src), lambda: from_torchvision(src), from_keras):
+        got = fn()
+        if got is not None:
+            x, y, xt, yt = got
+            # store uint8 HWC images; the loader normalizes to float32 on read
+            np.savez_compressed(
+                out / "cifar10.npz",
+                x_train=x.astype(np.uint8), y_train=y,
+                x_test=xt.astype(np.uint8), y_test=yt,
+            )
+            print(f"wrote {out/'cifar10.npz'}: train={x.shape} test={xt.shape}")
+            return 0
+    print(
+        "no CIFAR-10 source found (looked for cifar-10-batches-py/, torchvision "
+        "cache, keras cache). Download on a connected machine and copy the npz.",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Real-data loading + mixed-precision training.
+
+Covers VERDICT r1 item 2: the framework must show convergence on real data
+(sklearn digits is the real dataset available offline) and provide a bf16
+compute path with f32 master weights.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.data import loader as data_loader
+from fedml_tpu.models.hub import mixed_precision_apply
+from fedml_tpu.simulation.simulator import Simulator
+
+
+def _cfg(**train_overrides):
+    train = {
+        "federated_optimizer": "FedAvg",
+        "client_num_in_total": 8, "client_num_per_round": 8,
+        "comm_round": 10, "epochs": 2, "batch_size": 32,
+        "learning_rate": 0.1,
+    }
+    train.update(train_overrides)
+    return fedml_tpu.init(config={
+        "data_args": {"dataset": "digits", "partition_method": "hetero",
+                      "partition_alpha": 0.5},
+        "model_args": {"model": "mlp"},
+        "train_args": train,
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": "sp"},
+    })
+
+
+def test_digits_is_real_data():
+    ds = data_loader.load(_cfg())
+    assert not ds.synthetic
+    assert ds.num_classes == 10
+    assert ds.x_train.shape[2:] == (8, 8, 1)
+    # real digits: pixel intensities in [0,1], many distinct values
+    assert 0.0 <= ds.x_train.min() and ds.x_train.max() <= 1.0
+    assert len(np.unique(ds.y_test)) == 10
+
+
+def test_synthetic_fallback_is_flagged():
+    cfg = _cfg()
+    cfg.data_args.dataset = "cifar100"  # no npz in the test environment
+    ds = data_loader.load(cfg)
+    assert ds.synthetic
+
+
+def test_fedavg_converges_on_real_digits():
+    sim = Simulator(_cfg())
+    sim.run(10)
+    acc = sim.evaluate()["test_acc"]
+    assert acc > 0.7, f"digits non-IID FedAvg reached only {acc}"
+
+
+def test_bf16_params_stay_f32_and_converges():
+    sim = Simulator(_cfg(compute_dtype="bfloat16"))
+    # master weights remain f32 even though compute is bf16
+    dtypes = {a.dtype for a in jax.tree.leaves(sim.server_state.params)}
+    assert dtypes == {jnp.dtype(jnp.float32)}
+    sim.run(10)
+    assert {a.dtype for a in jax.tree.leaves(sim.server_state.params)} == {
+        jnp.dtype(jnp.float32)
+    }
+    acc = sim.evaluate()["test_acc"]
+    assert acc > 0.7, f"bf16 digits FedAvg reached only {acc}"
+
+
+def test_mixed_precision_apply_casts_compute():
+    """The wrapper runs the network in bf16 but returns f32 logits, and
+    gradients w.r.t. f32 params come back f32."""
+    from fedml_tpu.models import hub
+
+    model = hub.create("mlp", 10)
+    params = hub.init_params(model, (8, 8, 1), jax.random.key(0))
+    wrapped = mixed_precision_apply(model.apply, "bfloat16")
+    x = jnp.ones((4, 8, 8, 1), jnp.float32)
+    out = wrapped({"params": params}, x)
+    assert out.dtype == jnp.float32
+
+    g = jax.grad(lambda p: wrapped({"params": p}, x).sum())(params)
+    assert all(a.dtype == jnp.float32 for a in jax.tree.leaves(g))
+    # identity when dtype is f32
+    f = model.apply
+    assert mixed_precision_apply(f, "float32") is f
