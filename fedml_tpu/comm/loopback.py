@@ -38,6 +38,14 @@ def get_router(run_id: str) -> LoopbackRouter:
         return _routers[run_id]
 
 
+def release_router(run_id: str) -> None:
+    """Drop a finished run's router (and any undrained frames). Long-lived
+    processes that mint per-run ids must call this or the registry grows by
+    one mailbox set — potentially holding encoded model payloads — per run."""
+    with _routers_lock:
+        _routers.pop(run_id, None)
+
+
 class LoopbackTransport(BaseTransport):
     _STOP = object()
 

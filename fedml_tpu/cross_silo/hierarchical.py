@@ -112,8 +112,13 @@ def run_hierarchical(
         c.run(background=True)
     for c in clients:
         c.announce_ready()
-    if not server.done.wait(timeout=600):
-        raise TimeoutError("hierarchical cross-silo run did not finish")
-    for c in clients:
-        c.done.wait(timeout=30)
+    try:
+        if not server.done.wait(timeout=600):
+            raise TimeoutError("hierarchical cross-silo run did not finish")
+        for c in clients:
+            c.done.wait(timeout=30)
+    finally:
+        # per-run uuids would otherwise leak one mailbox set per invocation
+        from ..comm.loopback import release_router
+        release_router(run_id)
     return server

@@ -132,6 +132,17 @@ MODELS.register("resnet56")(
 MODELS.register("rnn")(lambda num_classes, **kw: CharRNN(vocab_size=num_classes))
 
 
+def _transformer_lm(num_classes, **kw):
+    from ..llm.transformer import TransformerLM
+
+    return TransformerLM(vocab_size=num_classes, **kw)
+
+
+# the FedLLM base model (llm/transformer.py); num_classes == vocab size,
+# size knobs (d_model/n_layers/n_heads/d_ff) pass through model_args.extra
+MODELS.register("transformer_lm")(_transformer_lm)
+
+
 def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
     """fedml.model.create equivalent (reference: model/model_hub.py:19)."""
     return MODELS.get(model_name)(num_classes=num_classes, **kwargs)
@@ -162,9 +173,12 @@ def mixed_precision_apply(apply_fn, compute_dtype: str):
 
 
 def init_params(module: nn.Module, input_shape: tuple, rng: jax.Array, dtype=jnp.float32):
+    from ..llm.transformer import TransformerLM
+
+    token_input = isinstance(module, (CharRNN, TransformerLM))
     dummy = (
         jnp.zeros((1,) + tuple(input_shape), dtype=jnp.int32)
-        if isinstance(module, CharRNN)
+        if token_input
         else jnp.zeros((1,) + tuple(input_shape), dtype=dtype)
     )
     return module.init(rng, dummy)["params"]

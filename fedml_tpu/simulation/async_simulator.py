@@ -65,7 +65,8 @@ class AsyncSimulator:
         t = cfg.train_args
         self.dataset = dataset if dataset is not None else data_loader.load(cfg)
         self.model = model if model is not None else model_hub.create(
-            cfg.model_args.model, self.dataset.num_classes)
+            cfg.model_args.model, self.dataset.num_classes,
+            **cfg.model_args.extra)
         rng = jax.random.key(cfg.common_args.random_seed)
         self.params = model_hub.init_params(
             self.model, self.dataset.x_train.shape[2:], rng)

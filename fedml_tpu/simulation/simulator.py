@@ -73,7 +73,7 @@ class Simulator:
         self.num_classes = self.dataset.num_classes
 
         self.model = model if model is not None else model_hub.create(
-            cfg.model_args.model, self.num_classes
+            cfg.model_args.model, self.num_classes, **cfg.model_args.extra
         )
         rng = jax.random.key(cfg.common_args.random_seed)
         self.params = model_hub.init_params(
