@@ -109,6 +109,10 @@ class GrpcTransport(BaseTransport):
     def stop_receive_message(self) -> None:
         self._running = False
         self._inbox.put(None)
+        # stop the server FIRST and wait out the grace period: peers may
+        # still be sending their final acks (C2S_FINISHED), and tearing the
+        # executor down under an in-flight accept raises noisy
+        # "cannot schedule new futures after shutdown" on the serve thread
+        self._server.stop(grace=1.0).wait(timeout=2.0)
         for ch in self._channels.values():
             ch.close()
-        self._server.stop(grace=0.5)

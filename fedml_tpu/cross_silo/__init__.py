@@ -12,10 +12,12 @@ model exchange over DCN (SURVEY.md §5.8 mapping).
 from .client import FedClientManager
 from .hierarchical import partition_devices, run_hierarchical, silo_mesh
 from .message_define import *  # noqa: F401,F403
+from .secagg_manager import SecAggClientManager, SecAggServerManager
 from .server import FedAggregator, FedServerManager
 from .trainer import SiloTrainer
 
 __all__ = [
     "FedClientManager", "FedServerManager", "FedAggregator", "SiloTrainer",
     "run_hierarchical", "silo_mesh", "partition_devices",
+    "SecAggClientManager", "SecAggServerManager",
 ]

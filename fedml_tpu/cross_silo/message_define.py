@@ -26,3 +26,22 @@ KEY_METRICS = "metrics"
 
 STATUS_ONLINE = "ONLINE"
 STATUS_FINISHED = "FINISHED"
+
+# --- SecAgg extension (reference: cross_silo/secagg/sa_message_define.py —
+# pk exchange 3/4, secret-share routing 5/6/11, active-client list 10)
+C2S_SA_PK = "c2s_sa_pk"                    # MSG_TYPE_C2S_SEND_PK_TO_SERVER
+S2C_SA_PKS = "s2c_sa_pks"                  # MSG_TYPE_S2C_OTHER_PK_TO_CLIENT
+C2S_SA_SHARES = "c2s_sa_shares"            # MSG_TYPE_C2S_SEND_SS_TO_SERVER
+S2C_SA_SHARES = "s2c_sa_shares"            # MSG_TYPE_S2C_OTHER_SS_TO_CLIENT
+C2S_SA_MASKED = "c2s_sa_masked"            # masked model upload
+S2C_SA_UNMASK_REQ = "s2c_sa_unmask_req"    # MSG_TYPE_S2C_ACTIVE_CLIENT_LIST
+C2S_SA_UNMASK = "c2s_sa_unmask"            # MSG_TYPE_C2S_SEND_SS_OTHERS...
+
+KEY_SA_PK = "sa_pk"
+KEY_SA_PKS = "sa_pks"
+KEY_SA_SHARES = "sa_shares"
+KEY_SA_MASKED = "sa_masked"
+KEY_SA_SURVIVORS = "sa_survivors"
+KEY_SA_DROPPED = "sa_dropped"
+KEY_SA_B_SHARES = "sa_b_shares"
+KEY_SA_SK_SHARES = "sa_sk_shares"

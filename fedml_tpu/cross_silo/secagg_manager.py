@@ -1,0 +1,460 @@
+"""Cross-silo secure aggregation over the message layer.
+
+The reference's 5-file secagg manager set (reference:
+cross_silo/secagg/sa_fedml_server_manager.py, sa_fedml_client_manager.py,
+sa_fedml_aggregator.py, sa_fedml_api.py, sa_message_define.py) drives
+core/mpc/secagg.py through an FSM: pk exchange (msg 3/4) → secret-share
+routing via the server (5/6) → masked model upload (7) → active-client list
+(10) → survivors' shares of others (11) → unmask. This module is the same
+protocol over fedml_tpu's comm layer, driving mpc/secagg.py:
+
+  setup (once):  C2S_SA_PK → S2C_SA_PKS → C2S_SA_SHARES (routed) →
+                 S2C_SA_SHARES (+ init model, starts round 0)
+  per round:     train → C2S_SA_MASKED (masked weighted params, n clear)
+                 all received → unmask (self-masks from shares) → next round
+  dropout:       round_timeout fires → S2C_SA_UNMASK_REQ(survivors, dropped)
+                 → C2S_SA_UNMASK (b-shares of survivors + sk-shares of
+                 dropped) → reconstruct sk_j → strip pairwise masks → next
+                 round (dropped clients are excluded from later rounds; the
+                 pairwise masks they would have contributed are stripped
+                 every round thereafter via the reconstructed seeds).
+
+Weighted mean under masking: clients mask quantize(params * n_i) and send
+n_i in the clear (weights are public in the reference too); the server
+divides the unmasked sum by sum(n_i). Magnitudes must satisfy
+|param| * n_i * m * 2^q_bits < p/2 — with the default 31-bit prime and
+q_bits=16 that allows sum(|param_i| * n_i) up to ~16k, plenty for cross-silo
+client counts; lower q_bits for bigger fleets.
+
+SECURITY SCOPE: inherits mpc/secagg.py's simulation-grade primitives (DH
+over the field prime, non-cryptographic PRG) and routes shares through the
+server unencrypted; see that module's docstring for the production
+substitution (X25519 + keyed PRF + per-holder encryption of shares).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..comm import FedCommManager, Message
+from ..mpc.secagg import SecAggClient, SecAggServer
+from ..utils.events import recorder
+from . import message_define as md
+from .trainer import SiloTrainer
+
+Pytree = Any
+log = logging.getLogger(__name__)
+
+
+def flatten_params(params: Pytree) -> np.ndarray:
+    """Deterministic pytree -> flat f64 vector (leaf order = jax.tree.leaves)."""
+    leaves = jax.tree.leaves(params)
+    return np.concatenate([np.asarray(l, np.float64).reshape(-1)
+                           for l in leaves])
+
+
+def unflatten_params(template: Pytree, vec: np.ndarray) -> Pytree:
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out, off = [], 0
+    for l in leaves:
+        n = int(np.size(l))
+        out.append(np.asarray(vec[off:off + n], np.float32).reshape(np.shape(l)))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class SecAggServerManager:
+    """Server FSM (reference: sa_fedml_server_manager.py:65-315).
+
+    round_timeout: like FedServerManager — after the deadline the round
+    closes over the survivors, with mask recovery for the dropped. Without a
+    timeout the server waits for every client (reference behavior)."""
+
+    def __init__(self, comm: FedCommManager, client_ids: list[int],
+                 init_params: Pytree, num_rounds: int,
+                 threshold: Optional[int] = None,
+                 eval_fn: Optional[Callable[[Pytree, int], dict]] = None,
+                 round_timeout: Optional[float] = None,
+                 q_bits: int = 16):
+        self.comm = comm
+        self.client_ids = list(client_ids)
+        self.n = len(self.client_ids)
+        self.t = threshold if threshold is not None else max(1, self.n // 2)
+        self.params = init_params
+        self.dim = flatten_params(init_params).size
+        self.num_rounds = num_rounds
+        self.q_bits = q_bits
+        self.round_idx = 0
+        self.eval_fn = eval_fn
+        self.round_timeout = round_timeout
+        self.server = SecAggServer(self.n, self.t, self.dim, q_bits=q_bits)
+
+        self.pks: dict[int, int] = {}
+        # routed setup shares: shares_for[holder][owner] = {"b":..,"sk":..}
+        self.shares_for: dict[int, dict[int, dict]] = {c: {} for c in client_ids}
+        self.masked: dict[int, tuple[np.ndarray, float]] = {}
+        self.active: set[int] = set(client_ids)      # not yet dropped
+        self.dropped_sk: dict[int, int] = {}         # dropped id -> sk
+        self.unmask_b: dict[int, dict[int, np.ndarray]] = {}
+        self.unmask_sk: dict[int, dict[int, np.ndarray]] = {}
+        self._awaiting_unmask = False
+        self.client_online: dict[int, bool] = {}
+        self.is_initialized = False
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.history: list[dict] = []
+        self.dropped_log: list[tuple[int, list[int]]] = []
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._rearm_count = 0
+        self.max_rearms = 5   # below-quorum retries before declaring failure
+
+        h = comm.register_message_receive_handler
+        h(md.CONNECTION_IS_READY, self._on_connection_ready)
+        h(md.C2S_CLIENT_STATUS, self._on_client_status)
+        h(md.C2S_SA_PK, self._on_pk)
+        h(md.C2S_SA_SHARES, self._on_shares)
+        h(md.C2S_SA_MASKED, self._on_masked)
+        h(md.C2S_SA_UNMASK, self._on_unmask)
+        # clients ack S2C_FINISH; an unregistered type raises in the receive
+        # loop, so the ack needs an explicit (no-op) handler
+        h(md.C2S_FINISHED, lambda _msg: None)
+
+    # ------------------------------------------------------------ handlers
+    def _on_connection_ready(self, msg: Message) -> None:
+        if self.is_initialized:
+            return
+        for cid in self.client_ids:
+            self.comm.send_message(
+                Message(md.S2C_CHECK_CLIENT_STATUS, 0, cid))
+
+    def _on_client_status(self, msg: Message) -> None:
+        if msg.get(md.KEY_STATUS) == md.STATUS_FINISHED:
+            return
+        with self._lock:
+            self.client_online[msg.sender_id] = True
+            if not self.is_initialized and all(
+                    self.client_online.get(c) for c in self.client_ids):
+                self.is_initialized = True
+                for cid in self.client_ids:
+                    self.comm.send_message(
+                        Message(md.S2C_INIT_CONFIG, 0, cid))
+
+    def _on_pk(self, msg: Message) -> None:
+        with self._lock:
+            self.pks[msg.sender_id] = int(msg.get(md.KEY_SA_PK))
+            if len(self.pks) < self.n:
+                return
+            pks_wire = {str(c): self.pks[c] for c in self.client_ids}
+            for cid in self.client_ids:
+                m = Message(md.S2C_SA_PKS, 0, cid)
+                m.add(md.KEY_SA_PKS, pks_wire)
+                self.comm.send_message(m)
+
+    def _on_shares(self, msg: Message) -> None:
+        """Route each client's shares to their holders (the server is the
+        relay, as in the reference: S2C_OTHER_SS_TO_CLIENT)."""
+        owner = msg.sender_id
+        shares = msg.get(md.KEY_SA_SHARES)  # {holder_str: {"b":.., "sk":..}}
+        with self._lock:
+            for holder_s, sh in shares.items():
+                self.shares_for[int(holder_s)][owner] = sh
+            ready = all(len(self.shares_for[c]) == self.n
+                        for c in self.client_ids)
+            if not ready:
+                return
+            # deliver routed shares + initial model; training starts
+            for cid in self.client_ids:
+                m = Message(md.S2C_SA_SHARES, 0, cid)
+                m.add(md.KEY_SA_SHARES,
+                      {str(o): sh for o, sh in self.shares_for[cid].items()})
+                m.add(md.KEY_MODEL_PARAMS, self.params)
+                m.add(md.KEY_ROUND, self.round_idx)
+                self.comm.send_message(m)
+            self._arm_timer()
+
+    def _on_masked(self, msg: Message) -> None:
+        with self._lock:
+            if int(msg.get(md.KEY_ROUND, -1)) != self.round_idx:
+                return
+            # a just-dropped client's late upload must not close the round
+            # while unmask shares are being collected — that would advance
+            # twice and wipe the model with an empty survivor set
+            if msg.sender_id not in self.active or self._awaiting_unmask:
+                return
+            self.masked[msg.sender_id] = (
+                np.asarray(msg.get(md.KEY_SA_MASKED), np.int64),
+                float(msg.get(md.KEY_NUM_SAMPLES, 1.0)),
+            )
+            if set(self.masked) >= self.active:
+                self._unmask_and_advance(dropped_now=set())
+
+    # ---------------------------------------------------- dropout recovery
+    def _arm_timer(self) -> None:
+        if self.round_timeout is None:
+            return
+        self._cancel_timer()
+        t = threading.Timer(self.round_timeout, self._on_timeout,
+                            args=(self.round_idx,))
+        t.daemon = True
+        t.start()
+        self._timer = t
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _on_timeout(self, armed_round: int) -> None:
+        with self._lock:
+            if self.done.is_set() or armed_round != self.round_idx:
+                return
+            if self._awaiting_unmask:
+                # survivors' unmask replies never reached t+1 — a survivor
+                # died between its masked upload and its share reply; the
+                # sum cannot be unmasked (that is SecAgg's privacy working
+                # as intended). Fail loudly rather than hang forever.
+                self._fail(f"round {self.round_idx}: unmask shares "
+                           f"({len(self.unmask_b)}) below t+1={self.t + 1}")
+                return
+            dropped_now = self.active - set(self.masked)
+            survivors = sorted(self.active - dropped_now)
+            if len(survivors) < self.t + 1:
+                self._rearm_count += 1
+                if self._rearm_count > self.max_rearms:
+                    self._fail(
+                        f"round {self.round_idx}: only {len(survivors)} "
+                        f"survivors < t+1={self.t + 1} after "
+                        f"{self.max_rearms} timeouts — quorum unreachable")
+                    return
+                log.warning("round %d: %d survivors < t+1=%d — re-arming "
+                            "(%d/%d)", self.round_idx, len(survivors),
+                            self.t + 1, self._rearm_count, self.max_rearms)
+                self._arm_timer()
+                return
+            self._rearm_count = 0
+            if not dropped_now:
+                return
+            log.warning("round %d: dropping %s", self.round_idx,
+                        sorted(dropped_now))
+            self.dropped_log.append((self.round_idx, sorted(dropped_now)))
+            self.active -= dropped_now
+            self._awaiting_unmask = True
+            self.unmask_b.clear()
+            self.unmask_sk.clear()
+            need_sk = [j for j in dropped_now if j not in self.dropped_sk]
+            for cid in survivors:
+                m = Message(md.S2C_SA_UNMASK_REQ, 0, cid)
+                m.add(md.KEY_SA_SURVIVORS, survivors)
+                m.add(md.KEY_SA_DROPPED, sorted(need_sk))
+                self.comm.send_message(m)
+            # guard the collection phase too: a survivor can die before
+            # replying with its shares
+            self._arm_timer()
+
+    def _fail(self, reason: str) -> None:
+        """Caller holds the lock. Record the error and shut down."""
+        log.error("secagg run failed: %s", reason)
+        self.error = reason
+        self._finish()
+
+    def _on_unmask(self, msg: Message) -> None:
+        holder = msg.sender_id
+        with self._lock:
+            if not self._awaiting_unmask:
+                return
+            self.unmask_b[holder] = {
+                int(o): np.asarray(v, np.int64)
+                for o, v in msg.get(md.KEY_SA_B_SHARES, {}).items()}
+            self.unmask_sk[holder] = {
+                int(o): np.asarray(v, np.int64)
+                for o, v in msg.get(md.KEY_SA_SK_SHARES, {}).items()}
+            if len(self.unmask_b) >= self.t + 1:
+                self._awaiting_unmask = False
+                self._unmask_and_advance(use_collected=True)
+
+    # ------------------------------------------------------------- rounds
+    def _proto(self, cid: int) -> int:
+        """Client id -> protocol index 0..n-1. The MPC kernel's Shamir
+        evaluation points and the +/- pairwise-mask convention both run on
+        protocol indices; everything crosses this boundary here."""
+        return self.client_ids.index(cid)
+
+    def _unmask_and_advance(self, dropped_now: set = frozenset(),
+                            use_collected: bool = False) -> None:
+        """Caller holds the lock. Unmask the survivor sum and advance."""
+        self._cancel_timer()
+        survivors = sorted(self.masked)
+        pr = self._proto
+        # b-shares: full participation -> from the routed setup shares;
+        # after a dropout -> from the survivors' unmask responses
+        if use_collected:
+            b_shares = {pr(h): {pr(o): sh for o, sh in shares.items()}
+                        for h, shares in self.unmask_b.items()}
+            # reconstruct newly-dropped clients' sk from survivor shares
+            per_owner: dict[int, dict[int, np.ndarray]] = {}
+            for holder, shares in self.unmask_sk.items():
+                for owner, sh in shares.items():
+                    per_owner.setdefault(owner, {})[pr(holder)] = sh
+            for owner, shs in per_owner.items():
+                if len(shs) >= self.t + 1:
+                    self.dropped_sk[owner] = SecAggServer.reconstruct_sk(
+                        dict(sorted(shs.items())[: self.t + 1]))
+        else:
+            b_shares = {
+                pr(h): {pr(o): np.asarray(sh["b"], np.int64)
+                        for o, sh in self.shares_for[h].items()}
+                for h in survivors}
+        pair_seeds = {
+            pr(j): {pr(i): SecAggServer.pairwise_seed(sk, self.pks[i])
+                    for i in survivors}
+            for j, sk in self.dropped_sk.items()}
+
+        with recorder.span("secagg_unmask", round=self.round_idx):
+            total = self.server.aggregate(
+                {pr(i): y for i, (y, _n) in self.masked.items()},
+                b_shares, pair_seeds, round_salt=self.round_idx)
+        wsum = sum(n for (_y, n) in self.masked.values())
+        vec = total / max(wsum, 1e-9)
+        self.params = unflatten_params(self.params, vec)
+
+        row = {"round": self.round_idx, "n_received": len(self.masked)}
+        if self.eval_fn is not None:
+            row.update(self.eval_fn(self.params, self.round_idx))
+        self.history.append(row)
+        recorder.log(row)
+        self.masked.clear()
+        self.round_idx += 1
+        if self.round_idx >= self.num_rounds:
+            self._finish()
+            return
+        for cid in sorted(self.active):
+            m = Message(md.S2C_SYNC_MODEL, 0, cid)
+            m.add(md.KEY_MODEL_PARAMS, self.params)
+            m.add(md.KEY_ROUND, self.round_idx)
+            self.comm.send_message(m)
+        self._arm_timer()
+
+    def _finish(self) -> None:
+        self._cancel_timer()
+        for cid in self.client_ids:
+            self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+        self.done.set()
+        threading.Thread(target=self.comm.stop, daemon=True).start()
+
+    def run(self, background: bool = False) -> None:
+        self.comm.run(background=background)
+
+
+class SecAggClientManager:
+    """Client FSM (reference: sa_fedml_client_manager.py). Wraps a
+    SiloTrainer; masks the weighted trained params before upload."""
+
+    def __init__(self, comm: FedCommManager, client_id: int,
+                 trainer: SiloTrainer, num_clients: int,
+                 client_ids: list[int], threshold: Optional[int] = None,
+                 server_id: int = 0, q_bits: int = 16, seed: int = 0):
+        self.comm = comm
+        self.client_id = client_id
+        self.server_id = server_id
+        self.trainer = trainer
+        self.client_ids = list(client_ids)
+        self.n = num_clients
+        self.t = threshold if threshold is not None else max(1, self.n // 2)
+        # protocol index 0..n-1 (Shamir evaluation points), stable ordering
+        self.proto_idx = self.client_ids.index(client_id)
+        self.sa = SecAggClient(self.proto_idx, self.n, self.t,
+                               q_bits=q_bits, seed=seed + client_id)
+        self.pks: dict[int, int] = {}          # protocol idx -> pk
+        self.recv_shares: dict[int, dict] = {}  # owner proto idx -> {"b","sk"}
+        self.done = threading.Event()
+
+        h = comm.register_message_receive_handler
+        h(md.S2C_CHECK_CLIENT_STATUS, self._on_check_status)
+        h(md.S2C_INIT_CONFIG, self._on_init)
+        h(md.S2C_SA_PKS, self._on_pks)
+        h(md.S2C_SA_SHARES, self._on_shares)
+        h(md.S2C_SYNC_MODEL, self._on_sync)
+        h(md.S2C_SA_UNMASK_REQ, self._on_unmask_req)
+        h(md.S2C_FINISH, self._on_finish)
+
+    def _cid_to_proto(self, cid: int) -> int:
+        return self.client_ids.index(cid)
+
+    def _on_check_status(self, msg: Message) -> None:
+        m = Message(md.C2S_CLIENT_STATUS, self.client_id, self.server_id)
+        m.add(md.KEY_STATUS, md.STATUS_ONLINE)
+        self.comm.send_message(m)
+
+    def _on_init(self, msg: Message) -> None:
+        m = Message(md.C2S_SA_PK, self.client_id, self.server_id)
+        m.add(md.KEY_SA_PK, self.sa.public_key())
+        self.comm.send_message(m)
+
+    def _on_pks(self, msg: Message) -> None:
+        # wire pks keyed by client id; protocol works on 0..n-1 indices
+        self.pks = {self._cid_to_proto(int(c)): int(pk)
+                    for c, pk in msg.get(md.KEY_SA_PKS).items()}
+        b_shares = self.sa.share_self_seed()    # [n, 1]
+        sk_shares = self.sa.share_sk()
+        out = Message(md.C2S_SA_SHARES, self.client_id, self.server_id)
+        out.add(md.KEY_SA_SHARES, {
+            str(self.client_ids[h]): {"b": b_shares[h], "sk": sk_shares[h]}
+            for h in range(self.n)})
+        self.comm.send_message(out)
+
+    def _on_shares(self, msg: Message) -> None:
+        self.recv_shares = {
+            self._cid_to_proto(int(o)): sh
+            for o, sh in msg.get(md.KEY_SA_SHARES).items()}
+        self._train_and_send(msg.get(md.KEY_MODEL_PARAMS),
+                             int(msg.get(md.KEY_ROUND, 0)))
+
+    def _on_sync(self, msg: Message) -> None:
+        self._train_and_send(msg.get(md.KEY_MODEL_PARAMS),
+                             int(msg.get(md.KEY_ROUND, 0)))
+
+    def _train_and_send(self, params, round_idx: int) -> None:
+        with recorder.span("sa_train", round=round_idx, client=self.client_id):
+            new_params, n, _metrics = self.trainer.train(params, round_idx)
+        vec = flatten_params(new_params) * float(n)
+        masked = self.sa.mask(vec, self.pks, round_salt=round_idx)
+        out = Message(md.C2S_SA_MASKED, self.client_id, self.server_id)
+        out.add(md.KEY_SA_MASKED, masked)
+        out.add(md.KEY_NUM_SAMPLES, n)
+        out.add(md.KEY_ROUND, round_idx)
+        self.comm.send_message(out)
+
+    def _on_unmask_req(self, msg: Message) -> None:
+        survivors = [int(c) for c in msg.get(md.KEY_SA_SURVIVORS)]
+        dropped = [int(c) for c in msg.get(md.KEY_SA_DROPPED)]
+        out = Message(md.C2S_SA_UNMASK, self.client_id, self.server_id)
+        out.add(md.KEY_SA_B_SHARES, {
+            str(c): self.recv_shares[self._cid_to_proto(c)]["b"]
+            for c in survivors if self._cid_to_proto(c) in self.recv_shares})
+        out.add(md.KEY_SA_SK_SHARES, {
+            str(c): self.recv_shares[self._cid_to_proto(c)]["sk"]
+            for c in dropped if self._cid_to_proto(c) in self.recv_shares})
+        self.comm.send_message(out)
+
+    def _on_finish(self, msg: Message) -> None:
+        m = Message(md.C2S_FINISHED, self.client_id, self.server_id)
+        m.add(md.KEY_STATUS, md.STATUS_FINISHED)
+        try:
+            self.comm.send_message(m)
+        except Exception:
+            pass
+        self.done.set()
+        self.comm.stop()
+
+    def run(self, background: bool = False) -> None:
+        self.comm.run(background=background)
+
+    def announce_ready(self) -> None:
+        self.comm.send_message(
+            Message(md.CONNECTION_IS_READY, self.client_id, self.server_id))

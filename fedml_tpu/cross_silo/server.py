@@ -116,6 +116,11 @@ class FedServerManager:
             md.C2S_CLIENT_STATUS, self._on_client_status)
         comm.register_message_receive_handler(
             md.C2S_SEND_MODEL, self._on_model_from_client)
+        # clients ack S2C_FINISH with C2S_FINISHED; an unregistered type
+        # raises in the receive loop, so the ack gets a no-op handler (the
+        # ack races the stop sentinel, especially over gRPC)
+        comm.register_message_receive_handler(
+            md.C2S_FINISHED, lambda _msg: None)
 
     # --- selection (reference: fedml_aggregator.client_selection — seeded by
     # round, matching fedavg_api.py:127-135)

@@ -95,6 +95,10 @@ def make_fedllm_seq_round(
     if attn == "ring":
         attn_fn = functools.partial(ring_attention, axis_name=seq_axis)
     elif attn == "ulysses":
+        if model.n_heads % n_seq:
+            raise ValueError(
+                f"ulysses needs n_heads ({model.n_heads}) divisible by the "
+                f"{seq_axis!r} axis size ({n_seq}); use attn='ring'")
         attn_fn = functools.partial(ulysses_attention, axis_name=seq_axis)
     else:
         raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")

@@ -68,7 +68,7 @@ class SecAggClient:
         secagg.py:337-342)."""
         return pow(peer_pk, self.sk, self.p) % (2**62)
 
-    # --- round 1: share the self-mask seed
+    # --- round 1: share the self-mask seed (and sk, for dropout recovery)
     def share_self_seed(self) -> np.ndarray:
         """Shamir shares [n, 1] of the self-mask seed, one per client."""
         return shamir_share(
@@ -76,16 +76,29 @@ class SecAggClient:
             self.num_clients, self.threshold, self._rng, self.p,
         )
 
+    def share_sk(self) -> np.ndarray:
+        """Shamir shares [n, 1] of the DH secret key. If this client drops
+        mid-round, t+1 survivors' shares let the server reconstruct sk and
+        derive the pairwise seeds to strip (reference:
+        sa_fedml_server_manager.py's ss_others flow)."""
+        return shamir_share(
+            np.asarray([self.sk], np.int64),
+            self.num_clients, self.threshold, self._rng, self.p,
+        )
+
     # --- round 2: masked input
-    def mask(self, x: np.ndarray, peer_pks: dict[int, int]) -> np.ndarray:
-        """y_i = quantize(x_i) + PRG(b_i) + sum_{j>i} PRG(s_ij) - sum_{j<i}."""
+    def mask(self, x: np.ndarray, peer_pks: dict[int, int],
+             round_salt: int = 0) -> np.ndarray:
+        """y_i = quantize(x_i) + PRG(b_i+salt) + sum_{j>i} PRG(s_ij+salt)
+        - sum_{j<i}. `round_salt` rotates every mask per round so the same
+        key material serves many rounds without mask reuse."""
         D = x.size
         y = quantize(x, self.q_bits, self.p)
-        y = (y + prg_mask(self.self_seed, D, self.p)) % self.p
+        y = (y + prg_mask(self.self_seed + round_salt, D, self.p)) % self.p
         for j, pk in peer_pks.items():
             if j == self.idx:
                 continue
-            pair = prg_mask(self.agree(pk), D, self.p)
+            pair = prg_mask(self.agree(pk) + round_salt, D, self.p)
             y = (y + pair) % self.p if j > self.idx else (y - pair) % self.p
         return y
 
@@ -107,9 +120,11 @@ class SecAggServer:
         pairwise_seeds_of_dropped: dict[int, dict[int, int]],
         # dropped j -> {peer i: s_ij} reconstructed by survivors
         weights: Optional[np.ndarray] = None,
+        round_salt: int = 0,
     ) -> np.ndarray:
         """Sum surviving masked vectors, strip surviving clients' self-masks
-        (reconstructed from shares) and dropped clients' pairwise masks."""
+        (reconstructed from shares) and dropped clients' pairwise masks.
+        `round_salt` must match the salt the clients masked with."""
         survivors = sorted(masked)
         agg = np.zeros(self.D, np.int64)
         for i in survivors:
@@ -130,18 +145,34 @@ class SecAggServer:
             seed = int(shamir_reconstruct(
                 np.stack([r.reshape(-1) for r in share_rows]), holders, self.p
             )[0])
-            agg = (agg - prg_mask(seed, self.D, self.p)) % self.p
+            agg = (agg - prg_mask(seed + round_salt, self.D, self.p)) % self.p
 
         # strip pairwise masks involving dropped clients
         for j, seeds in pairwise_seeds_of_dropped.items():
             for i in survivors:
                 if i not in seeds:
                     continue
-                pair = prg_mask(seeds[i], self.D, self.p)
+                pair = prg_mask(seeds[i] + round_salt, self.D, self.p)
                 # client i applied +pair if j > i else -pair; remove it
                 agg = (agg - pair) % self.p if j > i else (agg + pair) % self.p
 
         return dequantize(agg, self.q_bits, self.p)
+
+    @staticmethod
+    def reconstruct_sk(sk_shares: dict[int, np.ndarray],
+                       p: int = DEFAULT_PRIME) -> int:
+        """Reconstruct a dropped client's DH secret from t+1 survivors'
+        shares ({holder: share})."""
+        holders = sorted(sk_shares)
+        return int(shamir_reconstruct(
+            np.stack([np.asarray(sk_shares[h]).reshape(-1) for h in holders]),
+            holders, p)[0])
+
+    @staticmethod
+    def pairwise_seed(sk_j: int, pk_i: int, p: int = DEFAULT_PRIME) -> int:
+        """s_ij from a reconstructed sk_j and a survivor's public key —
+        the same value SecAggClient.agree computes on the other side."""
+        return pow(pk_i, sk_j, p) % (2 ** 62)
 
 
 def secagg_roundtrip(vectors: list[np.ndarray], threshold: Optional[int] = None,

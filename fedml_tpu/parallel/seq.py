@@ -27,20 +27,11 @@ encoded by the caller (the model passes pos_offset = axis_index * T_local).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 _NEG = -1e9  # finite "-inf": keeps exp() NaN-free for fully-masked rows
-
-
-def _vary(x, axis: str):
-    """Mark a freshly-created (replicated) value as device-varying over
-    `axis` so it can seed a loop carry whose body produces varying values."""
-    if hasattr(jax.lax, "pcast"):  # jax >= 0.9
-        return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))  # pragma: no cover
 
 
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,

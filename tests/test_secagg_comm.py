@@ -1,0 +1,176 @@
+"""SecAgg over the comm layer (reference: cross_silo/secagg/sa_fedml_*
+manager set). The secagg run must equal plain FedAvg (up to quantization),
+and a mid-run dropout must recover via survivor shares."""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm import FedCommManager
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.cross_silo import (
+    SecAggClientManager, SecAggServerManager, SiloTrainer,
+)
+from fedml_tpu.cross_silo.secagg_manager import flatten_params, unflatten_params
+from fedml_tpu.models import hub
+from fedml_tpu.ops import tree as tu
+
+
+def _mk_data(seed, n=48, d=8, k=3):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _plain_fedavg(model, t, datasets, params_np, rounds, active_from=None):
+    """Hand-rolled weighted FedAvg over SiloTrainers; active_from[r] gives
+    the participating client indices in round r (default: all)."""
+    trainers = [SiloTrainer(model.apply, t, x, y, seed=100 + i)
+                for i, (x, y) in enumerate(datasets)]
+    p = params_np
+    for r in range(rounds):
+        idxs = (active_from[r] if active_from is not None
+                else list(range(len(trainers))))
+        outs = [trainers[i].train(p, r) for i in idxs]
+        stacked = tu.tree_stack([jax.tree.map(jnp.asarray, o[0]) for o in outs])
+        w = jnp.asarray([o[1] for o in outs], jnp.float32)
+        p = jax.tree.map(np.asarray, tu.tree_weighted_mean(stacked, w))
+    return p
+
+
+def test_flatten_roundtrip():
+    model = hub.create("lr", 3)
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    vec = flatten_params(params)
+    back = unflatten_params(params, vec)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b), params, back)
+
+
+def _run_secagg(n_clients, rounds, run_id, dropper=None, round_timeout=None):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    datasets = [_mk_data(i) for i in range(n_clients)]
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    client_ids = list(range(1, n_clients + 1))
+
+    server = SecAggServerManager(
+        FedCommManager(LoopbackTransport(0, run_id), 0),
+        client_ids=client_ids, init_params=params_np, num_rounds=rounds,
+        round_timeout=round_timeout)
+    clients = []
+    for i, cid in enumerate(client_ids):
+        tr = SiloTrainer(model.apply, t, *datasets[i], seed=100 + i)
+        # warm the jit cache now so a first-compile stall can't eat into the
+        # round timeout (the dropout test relies on live clients replying
+        # well inside the deadline)
+        tr.train(params_np, 0)
+        if dropper is not None:
+            tr = dropper(cid, tr)
+        clients.append(SecAggClientManager(
+            FedCommManager(LoopbackTransport(cid, run_id), cid),
+            cid, tr, num_clients=n_clients, client_ids=client_ids))
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+    for c in clients:
+        c.announce_ready()
+    assert server.done.wait(timeout=180), "secagg server did not finish"
+    release_router(run_id)
+    return server, params_np, model, t, datasets
+
+
+def test_secagg_matches_plain_fedavg():
+    rounds = 3
+    server, params_np, model, t, datasets = _run_secagg(
+        3, rounds, "sa-parity")
+    assert len(server.history) == rounds
+    expected = _plain_fedavg(model, t, datasets, params_np, rounds)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-3),
+        server.params, expected)
+
+
+class _DroppingTrainer:
+    """Trains normally in round 0, goes silent from `drop_round` on (the
+    client process 'dies' mid-run)."""
+
+    def __init__(self, inner, drop_round):
+        self.inner = inner
+        self.drop_round = drop_round
+        self.n_samples = inner.n_samples
+
+    def train(self, params, round_idx):
+        if round_idx >= self.drop_round:
+            # simulate death: block forever (daemon thread, reaped at exit)
+            threading.Event().wait()
+        return self.inner.train(params, round_idx)
+
+
+def test_secagg_unmask_quorum_failure_is_loud():
+    """If survivors' unmask replies can't reach t+1 (a survivor dies between
+    masked upload and share reply), the server fails with error set instead
+    of hanging — SecAgg privacy means the sum is unrecoverable."""
+    import fedml_tpu.cross_silo.secagg_manager as sam
+
+    class MuteUnmaskClient(sam.SecAggClientManager):
+        def _on_unmask_req(self, msg):
+            pass  # died before replying
+
+    run_id = "sa-fail"
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    datasets = [_mk_data(i) for i in range(3)]
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    client_ids = [1, 2, 3]
+    server = SecAggServerManager(
+        FedCommManager(LoopbackTransport(0, run_id), 0),
+        client_ids=client_ids, init_params=params_np, num_rounds=3,
+        round_timeout=2.0)
+    clients = []
+    for i, cid in enumerate(client_ids):
+        tr = SiloTrainer(model.apply, t, *datasets[i], seed=100 + i)
+        tr.train(params_np, 0)
+        if cid == 3:
+            tr = _DroppingTrainer(tr, drop_round=1)
+        cls = MuteUnmaskClient if cid == 2 else SecAggClientManager
+        clients.append(cls(
+            FedCommManager(LoopbackTransport(cid, run_id), cid),
+            cid, tr, num_clients=3, client_ids=client_ids))
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+    for c in clients:
+        c.announce_ready()
+    assert server.done.wait(timeout=60), "server should fail loudly, not hang"
+    release_router(run_id)
+    assert server.error is not None and "unmask" in server.error
+
+
+def test_secagg_dropout_recovery():
+    """Client 3 dies after round 0; the server reconstructs its sk from
+    survivor shares, strips its pairwise masks, and the run matches plain
+    FedAvg with client 3 absent from rounds >= 1."""
+    rounds = 3
+    n = 3
+
+    def dropper(cid, tr):
+        return _DroppingTrainer(tr, drop_round=1) if cid == 3 else tr
+
+    server, params_np, model, t, datasets = _run_secagg(
+        n, rounds, "sa-drop", dropper=dropper, round_timeout=6.0)
+    assert len(server.history) == rounds
+    assert server.dropped_log and server.dropped_log[0][1] == [3]
+    active = [[0, 1, 2]] + [[0, 1]] * (rounds - 1)
+    expected = _plain_fedavg(model, t, datasets, params_np, rounds,
+                             active_from=active)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-3),
+        server.params, expected)
