@@ -45,3 +45,5 @@ KEY_SA_SURVIVORS = "sa_survivors"
 KEY_SA_DROPPED = "sa_dropped"
 KEY_SA_B_SHARES = "sa_b_shares"
 KEY_SA_SK_SHARES = "sa_sk_shares"
+KEY_SA_THRESHOLD = "sa_threshold"
+KEY_SA_QBITS = "sa_q_bits"

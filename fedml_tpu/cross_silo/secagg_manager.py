@@ -34,7 +34,6 @@ substitution (X25519 + keyed PRF + per-holder encryption of shares).
 from __future__ import annotations
 
 import logging
-import math
 import threading
 from typing import Any, Callable, Optional
 
@@ -142,8 +141,13 @@ class SecAggServerManager:
                     self.client_online.get(c) for c in self.client_ids):
                 self.is_initialized = True
                 for cid in self.client_ids:
-                    self.comm.send_message(
-                        Message(md.S2C_INIT_CONFIG, 0, cid))
+                    m = Message(md.S2C_INIT_CONFIG, 0, cid)
+                    # the server is authoritative for the protocol params —
+                    # a silent t/q_bits mismatch would corrupt the unmasked
+                    # model, so clients adopt these on init
+                    m.add(md.KEY_SA_THRESHOLD, self.t)
+                    m.add(md.KEY_SA_QBITS, self.q_bits)
+                    self.comm.send_message(m)
 
     def _on_pk(self, msg: Message) -> None:
         with self._lock:
@@ -192,7 +196,7 @@ class SecAggServerManager:
                 float(msg.get(md.KEY_NUM_SAMPLES, 1.0)),
             )
             if set(self.masked) >= self.active:
-                self._unmask_and_advance(dropped_now=set())
+                self._unmask_and_advance()
 
     # ---------------------------------------------------- dropout recovery
     def _arm_timer(self) -> None:
@@ -285,8 +289,7 @@ class SecAggServerManager:
         protocol indices; everything crosses this boundary here."""
         return self.client_ids.index(cid)
 
-    def _unmask_and_advance(self, dropped_now: set = frozenset(),
-                            use_collected: bool = False) -> None:
+    def _unmask_and_advance(self, use_collected: bool = False) -> None:
         """Caller holds the lock. Unmask the survivor sum and advance."""
         self._cancel_timer()
         survivors = sorted(self.masked)
@@ -343,7 +346,12 @@ class SecAggServerManager:
     def _finish(self) -> None:
         self._cancel_timer()
         for cid in self.client_ids:
-            self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+            try:
+                self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+            except Exception:
+                # dropped clients are exactly who may be unreachable here;
+                # a failed farewell must not prevent done from being set
+                log.debug("S2C_FINISH to %s failed", cid, exc_info=True)
         self.done.set()
         threading.Thread(target=self.comm.stop, daemon=True).start()
 
@@ -366,10 +374,13 @@ class SecAggClientManager:
         self.client_ids = list(client_ids)
         self.n = num_clients
         self.t = threshold if threshold is not None else max(1, self.n // 2)
+        self.q_bits = q_bits
+        self._seed = seed
         # protocol index 0..n-1 (Shamir evaluation points), stable ordering
         self.proto_idx = self.client_ids.index(client_id)
-        self.sa = SecAggClient(self.proto_idx, self.n, self.t,
-                               q_bits=q_bits, seed=seed + client_id)
+        # key material is minted in _on_init, once the server's
+        # authoritative threshold/q_bits arrive
+        self.sa: Optional[SecAggClient] = None
         self.pks: dict[int, int] = {}          # protocol idx -> pk
         self.recv_shares: dict[int, dict] = {}  # owner proto idx -> {"b","sk"}
         self.done = threading.Event()
@@ -392,6 +403,13 @@ class SecAggClientManager:
         self.comm.send_message(m)
 
     def _on_init(self, msg: Message) -> None:
+        # adopt the server's protocol parameters (they must match on both
+        # sides or reconstruction silently yields garbage)
+        self.t = int(msg.get(md.KEY_SA_THRESHOLD, self.t))
+        self.q_bits = int(msg.get(md.KEY_SA_QBITS, self.q_bits))
+        self.sa = SecAggClient(self.proto_idx, self.n, self.t,
+                               q_bits=self.q_bits,
+                               seed=self._seed + self.client_id)
         m = Message(md.C2S_SA_PK, self.client_id, self.server_id)
         m.add(md.KEY_SA_PK, self.sa.public_key())
         self.comm.send_message(m)

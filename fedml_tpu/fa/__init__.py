@@ -1,0 +1,20 @@
+"""Federated analytics — non-ML federated computation.
+
+(reference: python/fedml/fa/ — 2,557 LoC: FARunner, FAClientAnalyzer /
+FAServerAggregator ABCs, per-task analyzers + aggregators, trie utils.)
+
+Layer map position: L3 runtime (SURVEY.md §1), sibling of simulation/ and
+cross_silo/. Tasks are pure-function pairs in fa/tasks.py (avg, frequency
+estimation, union, intersection, k-percentile histogram, TrieHH heavy
+hitters with DP); runtimes in fa/runner.py (in-process FASimulator and a
+cross-silo manager pair over the comm layer).
+"""
+from .runner import (
+    FAClientManager, FASimulator, FAServerManager, run_fa_cross_silo,
+)
+from .tasks import FA_TASKS, FATask
+
+__all__ = [
+    "FA_TASKS", "FATask", "FASimulator", "FAServerManager",
+    "FAClientManager", "run_fa_cross_silo",
+]

@@ -1,0 +1,86 @@
+"""Federated analytics (reference: python/fedml/fa/). Each task's federated
+result must equal the centralized computation on the pooled data; TrieHH
+must discover the true heavy hitters; the cross-silo runtime must agree
+with the simulator."""
+import numpy as np
+import pytest
+
+from fedml_tpu.fa import FA_TASKS, FASimulator, run_fa_cross_silo
+
+
+def _numeric_clients(seed=0, n_clients=5, per=200):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(per) * (i + 1) + i for i in range(n_clients)]
+
+
+def test_avg_matches_centralized():
+    data = _numeric_clients()
+    sim = FASimulator("avg", data)
+    out = sim.run()
+    pooled = np.concatenate(data)
+    np.testing.assert_allclose(out, pooled.mean(), rtol=1e-9)
+
+
+def test_frequency_estimation_matches_centralized():
+    rs = np.random.RandomState(1)
+    data = [rs.randint(0, 7, 300) for _ in range(4)]
+    out = FASimulator("frequency_estimation", data).run()
+    pooled = np.concatenate(data)
+    for v in range(7):
+        np.testing.assert_allclose(
+            out[str(v)], (pooled == v).mean(), atol=1e-12)
+
+
+def test_union_and_intersection():
+    data = [[1, 2, 3, 4], [3, 4, 5], [4, 3, 9]]
+    assert FASimulator("union", data).run() == sorted(
+        {str(v) for v in [1, 2, 3, 4, 5, 9]})
+    assert FASimulator("intersection", data).run() == ["3", "4"]
+
+
+def test_k_percentile_histogram():
+    data = _numeric_clients(seed=2)
+    pooled = np.concatenate(data)
+    out = FASimulator("k_percentile", data, k=75.0, lo=-50, hi=50,
+                      bins=4096).run()
+    true = np.percentile(pooled, 75.0)
+    assert abs(out - true) < 0.1, (out, true)
+
+
+def test_triehh_finds_heavy_hitters():
+    """Two dominant words across clients; the trie must grow to contain
+    them and not the rare noise words."""
+    rs = np.random.RandomState(3)
+    vocab_heavy = ["sunshine", "moonlight"]
+    vocab_rare = ["aardvark", "zephyr", "quixote", "bramble"]
+    clients = []
+    for _ in range(10):
+        words = (vocab_heavy * 100
+                 + [vocab_rare[rs.randint(len(vocab_rare))] for _ in range(4)])
+        rs.shuffle(words)
+        clients.append(words)
+    sim = FASimulator("triehh", clients, num_rounds=12, epsilon=8.0)
+    out = sim.run()
+    full_words = [w for w in out if w in vocab_heavy]
+    assert set(full_words) == set(vocab_heavy), out
+    assert not any(w in out for w in vocab_rare), out
+
+
+def test_fa_cross_silo_matches_simulator():
+    data = [[1, 2, 3], [2, 3, 4], [3, 4, 5]]
+    server = run_fa_cross_silo("frequency_estimation", data)
+    sim_out = FASimulator("frequency_estimation", data).run()
+    assert server.result == sim_out
+    assert len(server.history) == 1
+
+
+def test_fa_cross_silo_avg():
+    data = _numeric_clients(n_clients=3, per=50)
+    server = run_fa_cross_silo("avg", data)
+    pooled = np.concatenate(data)
+    np.testing.assert_allclose(server.result, pooled.mean(), rtol=1e-9)
+
+
+def test_unknown_task_errors():
+    with pytest.raises(KeyError, match="fa_task"):
+        FA_TASKS.get("bogus_task")
