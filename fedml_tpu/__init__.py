@@ -51,6 +51,12 @@ def init(config_path: str | None = None, config: Config | dict | None = None,
     random.seed(cfg.common_args.random_seed)
     np.random.seed(cfg.common_args.random_seed)
     logging.basicConfig(level=logging.INFO)
+    # telemetry sinks (reference: mlops.init wires wandb/MQTT reporting at
+    # entry, core/mlops/__init__.py:91; here a local JSONL file + optional
+    # wandb, per tracking_args)
+    from .utils.sinks import attach_from_config
+
+    attach_from_config(cfg)
     return cfg
 
 

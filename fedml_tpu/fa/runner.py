@@ -137,7 +137,10 @@ class FAServerManager:
             if self.round_idx >= self.num_rounds or \
                     self.task.converged(self.server_data):
                 for cid in self.client_ids:
-                    self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+                    try:
+                        self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+                    except Exception:
+                        pass  # a dead client must not block done.set()
                 self.done.set()
                 threading.Thread(target=self.comm.stop, daemon=True).start()
                 return
@@ -155,13 +158,17 @@ class FAClientManager:
     """(reference: fa/cross_silo/fa_client.py)"""
 
     def __init__(self, comm: FedCommManager, client_id: int, data: Any,
-                 task: FATask, server_id: int = 0, seed: int = 0):
+                 task: FATask, server_id: int = 0, seed: int = 0,
+                 rng_id: Optional[int] = None):
         self.comm = comm
         self.client_id = client_id
         self.server_id = server_id
         self.data = data
         self.task = task
         self.seed = seed
+        # rng identity for sampling parity with FASimulator (which uses the
+        # 0-based data index); defaults to the wire client id
+        self.rng_id = client_id if rng_id is None else rng_id
         self.done = threading.Event()
         h = comm.register_message_receive_handler
         h(md.S2C_CHECK_CLIENT_STATUS, self._on_check)
@@ -176,7 +183,7 @@ class FAClientManager:
     def _on_round(self, msg: Message) -> None:
         r = int(msg.get(md.KEY_ROUND, 0))
         server_data = _decode_server_data(msg.get(KEY_SERVER_DATA))
-        rng = np.random.default_rng((self.seed, r, self.client_id))
+        rng = np.random.default_rng((self.seed, r, self.rng_id))
         with recorder.span("fa_analyze", round=r, client=self.client_id):
             sub = self.task.client_analyze(self.data, server_data, rng)
         out = Message(KEY_SUBMISSION, self.client_id, self.server_id)
@@ -236,7 +243,7 @@ def run_fa_cross_silo(task_name: str, client_data: Sequence[Any],
         client_ids=list(range(1, n + 1)), task=task, num_rounds=num_rounds)
     clients = [
         FAClientManager(FedCommManager(LoopbackTransport(cid, run_id), cid),
-                        cid, client_data[cid - 1], task)
+                        cid, client_data[cid - 1], task, rng_id=cid - 1)
         for cid in range(1, n + 1)
     ]
     try:

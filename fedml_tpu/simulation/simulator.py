@@ -254,10 +254,49 @@ class Simulator:
             m = jax.device_get(self._eval(params, *self._test))
         return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
 
-    def run(self, num_rounds: Optional[int] = None) -> list[dict]:
+    # ---------------------------------------------------- checkpoint/resume
+    # (beyond the reference: a killed reference run restarts from round 0 —
+    # SURVEY.md §5.4; here all cross-round state round-trips through orbax)
+    def save(self, ckpt_dir: str, keep: Optional[int] = 3) -> str:
+        from ..utils import checkpoint as ckpt
+
+        rounds_done = len(self.history)
+        return ckpt.save_checkpoint(
+            ckpt_dir, rounds_done - 1, self.server_state,
+            client_states=self.client_states, hook_state=self.hook_state,
+            history=self.history, keep=keep)
+
+    def restore(self, ckpt_dir: str) -> int:
+        """Load the latest checkpoint; returns the next round to run.
+        The sampler is round-seeded and the DP accountant is fast-forwarded,
+        so the resumed run continues exactly where the dead one stopped."""
+        from ..utils import checkpoint as ckpt
+
+        r, server, clients, hook, history = ckpt.restore_checkpoint(
+            ckpt_dir, self.server_state, self.client_states, self.hook_state)
+        self.server_state = server
+        if clients is not None:
+            self.client_states = clients
+        if hook is not None:
+            self.hook_state = hook
+        self.history = list(history)
+        rounds_done = r + 1
+        if self.dp.enabled and self.dp.accountant is not None:
+            self.dp.accountant.step(rounds_done)
+        return rounds_done
+
+    def run(self, num_rounds: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 0) -> list[dict]:
         t, v = self.cfg.train_args, self.cfg.validation_args
         rounds = num_rounds if num_rounds is not None else t.comm_round
-        for r in range(rounds):
+        start = 0
+        if checkpoint_dir is not None:
+            from ..utils.checkpoint import latest_round
+
+            if latest_round(checkpoint_dir) is not None:
+                start = self.restore(checkpoint_dir)
+        for r in range(start, rounds):
             row = {"round": r, **self.run_round(r)}
             if v.frequency_of_the_test and (
                 r % v.frequency_of_the_test == 0 or r == rounds - 1
@@ -265,8 +304,17 @@ class Simulator:
                 row.update(self.evaluate())
             recorder.log(row)
             self.history.append(row)
+            if checkpoint_dir is not None and checkpoint_every and (
+                (r + 1) % checkpoint_every == 0 or r == rounds - 1
+            ):
+                self.save(checkpoint_dir)
         return self.history
 
 
 def run_simulation(cfg: Config, dataset=None, model=None) -> list[dict]:
-    return Simulator(cfg, dataset, model).run()
+    # config-driven checkpointing: train_args.extra.checkpoint_dir enables
+    # save+auto-resume (every round by default; checkpoint_every overrides)
+    ckpt_dir = cfg.train_args.extra.get("checkpoint_dir")
+    every = int(cfg.train_args.extra.get("checkpoint_every", 1) or 0)
+    return Simulator(cfg, dataset, model).run(
+        checkpoint_dir=ckpt_dir, checkpoint_every=every if ckpt_dir else 0)

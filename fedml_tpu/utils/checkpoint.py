@@ -1,0 +1,138 @@
+"""Checkpoint/resume via orbax.
+
+The reference has NO training checkpointing — a killed run restarts from
+round 0 (SURVEY.md §5.4 flags this as a do-better gap; the closest thing is
+MLOps artifact upload, reference: core/mlops/__init__.py:388). Here every
+piece of cross-round state round-trips through orbax:
+
+    server_state   (params + opt state + round counter + algorithm extra)
+    client_states  (stacked per-client persistent state: SCAFFOLD c_i, ...)
+    hook_state     (defense history threaded across rounds, or None)
+    round_idx      (drives BOTH the round-seeded client sampler and the DP
+                    accountant fast-forward, so a resumed run is bitwise-
+                    identical to an uninterrupted one)
+
+Layout: <dir>/round_<n>/ orbax StandardCheckpointer trees + a `meta.json`
+sidecar (round, wall time, history tail) for cheap inspection without
+loading tensors.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+Pytree = Any
+
+_ROUND_RE = re.compile(r"^round_(\d+)$")
+# hook/client state may legitimately be absent; orbax cannot store None
+# leaves, so absence is encoded in meta.json instead
+_PARTS = ("server_state", "client_states", "hook_state")
+
+
+def _round_dir(path: str, round_idx: int) -> str:
+    return os.path.join(os.path.abspath(path), f"round_{round_idx}")
+
+
+def latest_round(path: str) -> Optional[int]:
+    """Highest complete checkpoint round under `path`, or None."""
+    if not os.path.isdir(path):
+        return None
+    rounds = []
+    for name in os.listdir(path):
+        m = _ROUND_RE.match(name)
+        if m and os.path.exists(os.path.join(path, name, "meta.json")):
+            rounds.append(int(m.group(1)))
+    return max(rounds) if rounds else None
+
+
+def save_checkpoint(path: str, round_idx: int, server_state: Pytree,
+                    client_states: Pytree = None, hook_state: Pytree = None,
+                    history: Optional[list] = None,
+                    keep: Optional[int] = 3) -> str:
+    """Write one checkpoint; returns its directory. `keep` prunes older
+    rounds (None keeps everything)."""
+    d = _round_dir(path, round_idx)
+    # a crash between the tree writes and meta.json leaves a half-written
+    # directory; orbax refuses to overwrite, so clear the stale attempt
+    # (only ever a meta-less dir — complete checkpoints are never re-saved)
+    if os.path.isdir(d) and not os.path.exists(os.path.join(d, "meta.json")):
+        import shutil
+
+        shutil.rmtree(d, ignore_errors=True)
+    ckptr = ocp.StandardCheckpointer()
+    present = {}
+    for name, tree in zip(_PARTS, (server_state, client_states, hook_state)):
+        present[name] = tree is not None
+        if tree is not None:
+            # wrap: orbax's pytree handler rejects bare-array "trees"
+            # (e.g. the engine's placeholder client_states vector)
+            ckptr.save(os.path.join(d, name),
+                       {"tree": jax.device_get(tree)})
+    ckptr.wait_until_finished()
+    # meta written LAST: its presence marks the checkpoint complete
+    # (latest_round ignores half-written directories)
+    meta = {"round": round_idx, "time": time.time(), "present": present,
+            "history": history or []}
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if keep is not None:
+        _prune(path, keep)
+    return d
+
+
+def restore_checkpoint(path: str, server_template: Pytree,
+                       client_template: Pytree = None,
+                       hook_template: Pytree = None,
+                       round_idx: Optional[int] = None):
+    """Restore (round_idx, server_state, client_states, hook_state, history).
+    Templates supply structure/shape/dtype (orbax StandardRestore); pass the
+    freshly-initialized states of a new run."""
+    r = round_idx if round_idx is not None else latest_round(path)
+    if r is None:
+        raise FileNotFoundError(f"no checkpoints under {path!r}")
+    d = _round_dir(path, r)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    ckptr = ocp.StandardCheckpointer()
+
+    def load(name, template):
+        if not meta["present"].get(name) or template is None:
+            return None
+        restored = ckptr.restore(
+            os.path.join(d, name), {"tree": template})["tree"]
+
+        # Re-establish the template's placement. Orbax returns arrays
+        # COMMITTED to a device; a fresh run's arrays are uncommitted (jit
+        # places them freely next to mesh-sharded data). Mesh-sharded
+        # templates get an explicit device_put; everything else goes back
+        # to an uncommitted array via host round-trip.
+        def place(t, r):
+            sh = getattr(t, "sharding", None)
+            if isinstance(sh, jax.sharding.NamedSharding):
+                return jax.device_put(r, sh)
+            return jnp.asarray(np.asarray(r))
+
+        return jax.tree.map(place, template, restored)
+
+    server = load("server_state", server_template)
+    clients = load("client_states", client_template)
+    hook = load("hook_state", hook_template)
+    return r, server, clients, hook, meta.get("history", [])
+
+
+def _prune(path: str, keep: int) -> None:
+    import shutil
+
+    rounds = sorted(
+        int(m.group(1)) for m in
+        (_ROUND_RE.match(n) for n in os.listdir(path)) if m)
+    for r in rounds[:-keep] if keep else []:
+        shutil.rmtree(_round_dir(path, r), ignore_errors=True)

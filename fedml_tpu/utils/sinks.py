@@ -1,0 +1,97 @@
+"""Metric/event sinks for the process-wide recorder.
+
+The reference reports every span and round metric to its MLOps cloud over
+MQTT (+wandb when enabled) (reference: core/mlops/__init__.py:153-220
+event/log/log_round_info, mlops/__init__.py wandb wiring). Local-first
+equivalents:
+
+- JsonlSink: append-only events file under tracking_args.log_file_dir —
+  one JSON object per span/metric, flushed per write so a killed run keeps
+  its telemetry.
+- WandbSink: forwards metric rows to wandb when it is importable AND
+  tracking_args.enable_wandb is set; silently absent otherwise (this image
+  has no wandb egress).
+
+`attach_from_config` is called by fedml_tpu.init, so any run with
+tracking_args.enable_tracking lands telemetry on disk with zero user code —
+the reference's "everything reports per round" behavior (SURVEY §5.5).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from .events import recorder
+
+
+class JsonlSink:
+    """Append JSON-lines events to <dir>/<run_name>.events.jsonl."""
+
+    def __init__(self, log_dir: str, run_name: str = "fedml_tpu_run"):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, f"{run_name}.events.jsonl")
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a")
+
+    def __call__(self, kind: str, payload: dict) -> None:
+        row = {"t": time.time(), "kind": kind, **_jsonable(payload)}
+        with self._lock:
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+class WandbSink:
+    def __init__(self, run_name: str, config: Optional[dict] = None):
+        import wandb  # gated: raises ImportError when not installed
+
+        self._wandb = wandb
+        self._run = wandb.init(project="fedml_tpu", name=run_name,
+                               config=config or {})
+
+    def __call__(self, kind: str, payload: dict) -> None:
+        if kind == "metrics":
+            self._wandb.log(_jsonable(payload))
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out
+
+
+def attach_from_config(cfg) -> list:
+    """Register sinks per tracking_args; returns the attached sink objects.
+    Idempotent per (dir, run_name): repeated init calls don't double-log."""
+    t = cfg.tracking_args
+    attached = []
+    if not t.enable_tracking:
+        return attached
+    key = (os.path.abspath(t.log_file_dir), t.run_name)
+    existing = {getattr(s, "_attach_key", None) for s in recorder.sinks}
+    if key not in existing:
+        sink = JsonlSink(t.log_file_dir, t.run_name)
+        sink._attach_key = key
+        recorder.sinks.append(sink)
+        attached.append(sink)
+    wkey = ("wandb", t.run_name)
+    if t.enable_wandb and wkey not in existing:
+        try:
+            wsink = WandbSink(t.run_name)
+            wsink._attach_key = wkey
+            recorder.sinks.append(wsink)
+            attached.append(wsink)
+        except Exception:  # wandb absent or offline — tracked locally only
+            pass
+    return attached

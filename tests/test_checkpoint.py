@@ -1,0 +1,105 @@
+"""Checkpoint/resume (SURVEY §5.4 — the reference restarts from round 0;
+here a resumed run must be bitwise-identical to an uninterrupted one) and
+metric sinks."""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.simulation.simulator import Simulator
+from fedml_tpu.utils.checkpoint import latest_round, restore_checkpoint
+from fedml_tpu.utils.events import recorder
+
+
+def _cfg(**train_over):
+    train = {
+        "federated_optimizer": "SCAFFOLD",   # exercises client_states too
+        "client_num_in_total": 6,
+        "client_num_per_round": 4,
+        "comm_round": 6,
+        "epochs": 1,
+        "batch_size": 8,
+        "learning_rate": 0.1,
+    }
+    train.update(train_over)
+    return fedml_tpu.init(config={
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 16}},
+        "model_args": {"model": "lr"},
+        "train_args": train,
+        "validation_args": {"frequency_of_the_test": 0},
+    })
+
+
+def test_kill_and_resume_is_identical(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+
+    # uninterrupted reference run
+    full = Simulator(_cfg()).run()
+
+    # interrupted: run 3 rounds with checkpointing, then "die"
+    sim1 = Simulator(_cfg())
+    sim1.run(num_rounds=3, checkpoint_dir=ckpt, checkpoint_every=1)
+    assert latest_round(ckpt) == 2
+    del sim1
+
+    # fresh process: new Simulator restores and finishes
+    sim2 = Simulator(_cfg())
+    hist = sim2.run(checkpoint_dir=ckpt, checkpoint_every=0)
+    assert [h["round"] for h in hist] == list(range(6))
+    for a, b in zip(full, hist):
+        np.testing.assert_allclose(a["train_loss"], b["train_loss"],
+                                   rtol=1e-6)
+    # final params identical to the uninterrupted run
+    ref = Simulator(_cfg())
+    ref_hist = ref.run()
+    # (re-run because `full`'s simulator was consumed; determinism makes
+    # this equal to `full`)
+    sim_full = Simulator(_cfg())
+    sim_full.run()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        sim2.server_state.params, sim_full.server_state.params)
+
+
+def test_restore_raises_without_checkpoints(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "nope"), {})
+
+
+def test_checkpoint_pruning(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    sim = Simulator(_cfg(comm_round=5, federated_optimizer="FedAvg"))
+    sim.run(checkpoint_dir=ckpt, checkpoint_every=1)
+    rounds = sorted(int(n.split("_")[1]) for n in os.listdir(ckpt)
+                    if n.startswith("round_"))
+    assert rounds == [2, 3, 4]  # keep=3 default
+
+
+def test_jsonl_sink_records_rounds(tmp_path):
+    cfg = _cfg(comm_round=2, federated_optimizer="FedAvg")
+    cfg.tracking_args.enable_tracking = True
+    cfg.tracking_args.log_file_dir = str(tmp_path)
+    cfg.tracking_args.run_name = "sinktest"
+    n_before = len(recorder.sinks)
+    cfg = fedml_tpu.init(config=cfg)   # attaches the sink
+    try:
+        Simulator(cfg).run()
+        path = tmp_path / "sinktest.events.jsonl"
+        rows = [json.loads(l) for l in path.read_text().splitlines()]
+        kinds = {r["kind"] for r in rows}
+        assert "metrics" in kinds and "span" in kinds
+        rounds = [r["round"] for r in rows
+                  if r["kind"] == "metrics" and "round" in r]
+        assert rounds[-1] == 1
+        # idempotent: init again must not double-attach
+        fedml_tpu.init(config=cfg)
+        assert len(recorder.sinks) == n_before + 1
+    finally:
+        for s in recorder.sinks[n_before:]:
+            getattr(s, "close", lambda: None)()
+        del recorder.sinks[n_before:]

@@ -74,6 +74,16 @@ def test_fa_cross_silo_matches_simulator():
     assert len(server.history) == 1
 
 
+def test_fa_cross_silo_triehh_matches_simulator():
+    """Stochastic task parity: both runtimes must subsample identically
+    (same (seed, round, data-index) rng identity)."""
+    words = [["the"] * 200 + ["and"] * 160 + ["xylophone"] for _ in range(6)]
+    sim_out = FASimulator("triehh", words, num_rounds=8, epsilon=8.0).run()
+    server = run_fa_cross_silo("triehh", words, num_rounds=8, epsilon=8.0)
+    assert server.result == sim_out
+    assert "the" in sim_out and "and" in sim_out
+
+
 def test_fa_cross_silo_avg():
     data = _numeric_clients(n_clients=3, per=50)
     server = run_fa_cross_silo("avg", data)
