@@ -1,0 +1,47 @@
+"""Model serving — predictors + HTTP inference runner.
+
+(reference: python/fedml/serving/ — 1,990 LoC: FedMLPredictor ABC,
+FedMLInferenceRunner FastAPI app, fedml_server.py reusing cross-silo init
+for federated serving.)
+
+Layer map position: L3 runtime (SURVEY.md §1). The compute path is a jitted
+bucketed forward (serving/predictor.py); the HTTP surface mirrors the
+reference's /predict + /ready contract (serving/inference_runner.py).
+`serve_simulator` is the federated-serving bridge: serve the global model a
+Simulator trained (or a checkpoint directory it saved).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .inference_runner import DEFAULT_PORT, FedMLInferenceRunner
+from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
+
+__all__ = [
+    "Predictor", "JaxPredictor", "GreedyLMPredictor",
+    "FedMLInferenceRunner", "DEFAULT_PORT", "serve_simulator",
+    "predictor_from_checkpoint",
+]
+
+
+def predictor_from_checkpoint(ckpt_dir: str, apply_fn: Callable,
+                              server_template) -> JaxPredictor:
+    """Load the latest orbax checkpoint's global model and wrap it as a
+    predictor (reference analog: fedml_server.py serving the aggregated
+    model; here the source of truth is utils/checkpoint.py state)."""
+    from ..utils.checkpoint import restore_checkpoint
+
+    _r, server, _c, _h, _hist = restore_checkpoint(ckpt_dir, server_template)
+    return JaxPredictor(apply_fn, server.params)
+
+
+def serve_simulator(sim, host: str = "127.0.0.1", port: int = 0,
+                    background: bool = True) -> FedMLInferenceRunner:
+    """Serve a (trained) Simulator's global model over HTTP."""
+    pred = JaxPredictor(sim.apply_fn, sim.server_state.params)
+    runner = FedMLInferenceRunner(pred, host=host, port=port)
+    if background:
+        runner.start()
+    else:
+        runner.run()
+    return runner

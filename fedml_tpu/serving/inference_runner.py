@@ -1,0 +1,84 @@
+"""HTTP inference runner — /predict + /ready over stdlib http.server.
+
+(reference: serving/fedml_inference_runner.py:4-24 — FastAPI + uvicorn
+exposing POST /predict -> {"generated_text": ...} and GET /ready. FastAPI
+is not in this image, so the same contract rides ThreadingHTTPServer: every
+request handled on its own thread, the predictor itself serializes device
+work through jit.)
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .predictor import Predictor
+
+log = logging.getLogger(__name__)
+DEFAULT_PORT = 2345  # reference: fedml_inference_runner.py port
+
+
+class FedMLInferenceRunner:
+    """Serve a Predictor over HTTP.
+
+    run() blocks (reference behavior); start()/stop() run it on a daemon
+    thread for embedding in tests and larger processes."""
+
+    def __init__(self, predictor: Predictor, host: str = "127.0.0.1",
+                 port: int = DEFAULT_PORT):
+        self.predictor = predictor
+        runner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet the default stderr spam
+                log.debug("serving: " + fmt, *args)
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    self._send(200, {"status": "Success"})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    input_json = json.loads(self.rfile.read(n) or b"{}")
+                    result = runner.predictor.predict(input_json)
+                    if not isinstance(result, dict):
+                        result = {"generated_text": str(result)}
+                    self._send(200, result)
+                except Exception as e:  # noqa: BLE001 — surface to caller
+                    log.exception("predict failed")
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]  # resolved when port=0
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> None:
+        log.info("serving on :%d (/predict, /ready)", self.port)
+        self._server.serve_forever()
+
+    def start(self) -> "FedMLInferenceRunner":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,123 @@
+"""Predictors: the servable model contract + JAX implementations.
+
+(reference: serving/fedml_predictor.py:10 — FedMLPredictor ABC with one
+`predict(input_json)` method; user subclasses wrap their model.)
+
+TPU-first details in JaxPredictor:
+- the forward pass is jitted ONCE per batch bucket: inputs are padded up to
+  the nearest power-of-two batch so arbitrary request sizes reuse a handful
+  of compiled programs instead of recompiling per shape (XLA static-shape
+  rule; SURVEY §7 design stance).
+- bf16 compute via models/hub.mixed_precision_apply composes here too —
+  pass the wrapped apply_fn.
+
+GreedyLMPredictor serves the FedLLM slice (llm/TransformerLM + merged LoRA):
+greedy argmax decoding with a jitted single-step; the KV recompute per step
+is O(T^2) but fine for the smoke-serving path (a cached-KV decode loop is a
+perf follow-up, not a correctness one).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+class Predictor(Protocol):
+    """reference: serving/fedml_predictor.py FedMLPredictor.predict."""
+
+    def predict(self, input_json: dict) -> Any: ...
+
+
+def _bucket(n: int, pow2_cap: int = 1024) -> int:
+    """Power-of-two buckets up to the cap, then multiples of the cap — every
+    batch size maps to a bounded set of compiled programs."""
+    if n > pow2_cap:
+        return ((n + pow2_cap - 1) // pow2_cap) * pow2_cap
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class JaxPredictor:
+    """Classification predictor over (apply_fn, params).
+
+    predict({"inputs": [[...], ...]}) -> {"predictions": [...],
+    "probabilities": [[...], ...]} — batch padded to a power-of-two bucket,
+    one jitted program per bucket."""
+
+    def __init__(self, apply_fn: Callable, params: Pytree,
+                 return_probs: bool = True):
+        self.params = params
+        self.return_probs = return_probs
+
+        @jax.jit
+        def fwd(params, x):
+            logits = apply_fn({"params": params}, x)
+            return jnp.argmax(logits, -1), jax.nn.softmax(logits, -1)
+
+        self._fwd = fwd
+
+    def predict(self, input_json: dict) -> dict:
+        x = np.asarray(input_json["inputs"], np.float32)
+        n = x.shape[0]
+        b = _bucket(n)
+        if b > n:
+            x = np.concatenate([x, np.zeros((b - n,) + x.shape[1:], x.dtype)])
+        labels, probs = self._fwd(self.params, jnp.asarray(x))
+        out = {"predictions": np.asarray(labels)[:n].tolist()}
+        if self.return_probs:
+            out["probabilities"] = np.asarray(probs)[:n].round(6).tolist()
+        return out
+
+
+class GreedyLMPredictor:
+    """Causal-LM predictor for llm/TransformerLM (optionally with LoRA
+    merged via llm.lora.lora_merge before construction).
+
+    predict({"tokens": [...], "max_new_tokens": k}) ->
+    {"generated_tokens": [...], "generated_text": "..."} (text only when a
+    detokenizer fn is supplied)."""
+
+    def __init__(self, model, params: Pytree,
+                 detokenize: Optional[Callable[[list[int]], str]] = None,
+                 max_len: int = 256):
+        self.model = model
+        self.params = params
+        self.detokenize = detokenize
+        self.max_len = max_len
+
+        @jax.jit
+        def step(params, tokens, length):
+            logits = model.apply({"params": params}, tokens)
+            # next token = argmax at the last REAL position
+            return jnp.argmax(logits[0, length - 1])
+
+        self._step = step
+
+    def predict(self, input_json: dict) -> dict:
+        toks = list(int(t) for t in input_json["tokens"])
+        new = int(input_json.get("max_new_tokens", 16))
+        # fixed-size buffer => one compiled program for every request
+        buf = np.zeros((1, self.max_len), np.int32)
+        if len(toks) + new > self.max_len:
+            raise ValueError(
+                f"prompt {len(toks)} + max_new_tokens {new} exceeds "
+                f"max_len {self.max_len}")
+        buf[0, : len(toks)] = toks
+        length = len(toks)
+        for _ in range(new):
+            nxt = int(self._step(self.params, jnp.asarray(buf),
+                                 jnp.int32(length)))
+            buf[0, length] = nxt
+            length += 1
+        gen = buf[0, len(toks):length].tolist()
+        out = {"generated_tokens": gen}
+        if self.detokenize is not None:
+            out["generated_text"] = self.detokenize(gen)
+        return out

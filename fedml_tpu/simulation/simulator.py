@@ -261,6 +261,10 @@ class Simulator:
         from ..utils import checkpoint as ckpt
 
         rounds_done = len(self.history)
+        if rounds_done == 0:
+            raise ValueError(
+                "nothing to checkpoint: no rounds have completed (a "
+                "round_-1 directory would be invisible to restore)")
         return ckpt.save_checkpoint(
             ckpt_dir, rounds_done - 1, self.server_state,
             client_states=self.client_states, hook_state=self.hook_state,
@@ -282,7 +286,12 @@ class Simulator:
         self.history = list(history)
         rounds_done = r + 1
         if self.dp.enabled and self.dp.accountant is not None:
-            self.dp.accountant.step(rounds_done)
+            # fast-forward only the MISSING compositions: this instance may
+            # already have stepped the accountant (restore-to-extend on a
+            # live Simulator)
+            missing = rounds_done - self.dp.accountant.steps
+            if missing > 0:
+                self.dp.accountant.step(missing)
         return rounds_done
 
     def run(self, num_rounds: Optional[int] = None,

@@ -77,12 +77,16 @@ def save_checkpoint(path: str, round_idx: int, server_state: Pytree,
             ckptr.save(os.path.join(d, name),
                        {"tree": jax.device_get(tree)})
     ckptr.wait_until_finished()
-    # meta written LAST: its presence marks the checkpoint complete
-    # (latest_round ignores half-written directories)
+    # meta written LAST and atomically (tmp + rename): its presence marks
+    # the checkpoint complete, so it must never exist half-written
     meta = {"round": round_idx, "time": time.time(), "present": present,
             "history": history or []}
-    with open(os.path.join(d, "meta.json"), "w") as f:
+    tmp = os.path.join(d, "meta.json.tmp")
+    with open(tmp, "w") as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(d, "meta.json"))
     if keep is not None:
         _prune(path, keep)
     return d

@@ -37,8 +37,9 @@ def _cfg(**train_over):
 def test_kill_and_resume_is_identical(tmp_path):
     ckpt = str(tmp_path / "ckpt")
 
-    # uninterrupted reference run
-    full = Simulator(_cfg()).run()
+    # uninterrupted reference run (keep the simulator for param comparison)
+    sim_full = Simulator(_cfg())
+    full = sim_full.run()
 
     # interrupted: run 3 rounds with checkpointing, then "die"
     sim1 = Simulator(_cfg())
@@ -54,12 +55,6 @@ def test_kill_and_resume_is_identical(tmp_path):
         np.testing.assert_allclose(a["train_loss"], b["train_loss"],
                                    rtol=1e-6)
     # final params identical to the uninterrupted run
-    ref = Simulator(_cfg())
-    ref_hist = ref.run()
-    # (re-run because `full`'s simulator was consumed; determinism makes
-    # this equal to `full`)
-    sim_full = Simulator(_cfg())
-    sim_full.run()
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
