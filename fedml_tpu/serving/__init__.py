@@ -12,7 +12,7 @@ Simulator trained (or a checkpoint directory it saved).
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 from .inference_runner import DEFAULT_PORT, FedMLInferenceRunner
 from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
@@ -37,8 +37,14 @@ def predictor_from_checkpoint(ckpt_dir: str, apply_fn: Callable,
 
 def serve_simulator(sim, host: str = "127.0.0.1", port: int = 0,
                     background: bool = True) -> FedMLInferenceRunner:
-    """Serve a (trained) Simulator's global model over HTTP."""
-    pred = JaxPredictor(sim.apply_fn, sim.server_state.params)
+    """Serve a (trained) Simulator's global model over HTTP. Params are
+    copied: the round engine donates its server state, so serving by
+    reference would break if training continues after this call."""
+    import jax
+    import jax.numpy as jnp
+
+    pred = JaxPredictor(
+        sim.apply_fn, jax.tree.map(jnp.array, sim.server_state.params))
     runner = FedMLInferenceRunner(pred, host=host, port=port)
     if background:
         runner.start()

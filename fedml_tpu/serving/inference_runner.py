@@ -67,9 +67,11 @@ class FedMLInferenceRunner:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]  # resolved when port=0
         self._thread: Optional[threading.Thread] = None
+        self._serving = False
 
     def run(self) -> None:
         log.info("serving on :%d (/predict, /ready)", self.port)
+        self._serving = True
         self._server.serve_forever()
 
     def start(self) -> "FedMLInferenceRunner":
@@ -78,7 +80,10 @@ class FedMLInferenceRunner:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() blocks on an event only serve_forever sets — calling
+        # it on a never-started server would deadlock
+        if self._serving:
+            self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -102,6 +102,8 @@ class GreedyLMPredictor:
 
     def predict(self, input_json: dict) -> dict:
         toks = list(int(t) for t in input_json["tokens"])
+        if not toks:
+            raise ValueError("tokens must contain at least one prompt token")
         new = int(input_json.get("max_new_tokens", 16))
         # fixed-size buffer => one compiled program for every request
         buf = np.zeros((1, self.max_len), np.int32)

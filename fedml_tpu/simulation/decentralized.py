@@ -1,0 +1,177 @@
+"""Decentralized FL: DSGD + PushSum as gossip-matrix programs.
+
+(reference: simulation/sp/decentralized/ — ClientDSGD/ClientPushsum objects
+exchange neighbor weights through per-client dicts each iteration,
+decentralized_fl_api.py drives them; topologies from
+core/distributed/topology/.)
+
+TPU design: there are no client objects. All N clients' params live as one
+stacked pytree [N, ...]; an iteration is
+
+    vmap local SGD step  ->  gossip:  params' = W @ params  (one einsum)
+
+with W the row-stochastic mixing matrix from comm/topology.py. The einsum
+contracts the client axis on the MXU — the entire neighbor exchange that the
+reference does with python dict passing is a single [N, N] x [N, D] matmul.
+The full T-iteration run is one lax.scan under jit.
+
+PushSum (Nedic & Olshevsky; reference: client_pushsum.py) handles DIRECTED
+graphs where W is not doubly stochastic: each node pushes mass to its
+out-neighbors with a COLUMN-stochastic matrix P, carries a scalar weight
+omega, and de-biases its estimate as z = x / omega. Same einsum shape.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..comm.topology import AsymmetricTopologyManager, SymmetricTopologyManager
+from ..core.algorithm import masked_softmax_ce
+
+Pytree = Any
+
+
+def column_stochastic(topology: np.ndarray) -> np.ndarray:
+    """Push matrix for PushSum: every node splits its mass evenly among the
+    nodes that listen to it (adjacency columns normalized to 1). Derived
+    from the same matrix as the listen graph, so push and listen can never
+    disagree (the round-1 asymmetric-topology bug class)."""
+    adj = (topology > 0).astype(np.float64)
+    return adj / adj.sum(axis=0, keepdims=True)
+
+
+def _gossip(stacked: Pytree, W: jax.Array) -> Pytree:
+    """params' = W @ params over the leading client axis, per leaf."""
+    return jax.tree.map(
+        lambda a: jnp.einsum(
+            "ij,j...->i...", W.astype(a.dtype), a), stacked)
+
+
+def _build_run(apply_fn: Callable, W: jax.Array, lr: float,
+               batch_size: int, weight_decay: float, pushsum: bool):
+    opt = optax.sgd(lr)
+
+    def local_step(p, shard, rng):
+        s = shard["y"].shape[0]
+        idx = jax.random.choice(rng, s, (min(batch_size, s),), replace=False)
+        batch = {k: v[idx] for k, v in shard.items()}
+
+        def loss_fn(pp):
+            logits = apply_fn({"params": pp}, batch["x"])
+            loss, _c, _n = masked_softmax_ce(
+                logits, batch["y"], batch["mask"])
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        if weight_decay:
+            grads = jax.tree.map(lambda g, pp: g + weight_decay * pp,
+                                 grads, p)
+        updates, _ = opt.update(grads, opt.init(p), p)
+        return optax.apply_updates(p, updates), loss
+
+    def run(stacked0: Pytree, data: dict, rng: jax.Array, iters: int):
+        n = data["y"].shape[0]
+        omega0 = jnp.ones((n,))
+
+        def body(carry, it):
+            x, omega = carry
+            # de-biased estimate: PushSum trains on z = x/omega, DSGD on x
+            if pushsum:
+                z = jax.tree.map(
+                    lambda a: a / omega.reshape((-1,) + (1,) * (a.ndim - 1)),
+                    x)
+            else:
+                z = x
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(jax.random.fold_in(rng, it), i)
+            )(jnp.arange(n))
+            new_z, losses = jax.vmap(local_step, in_axes=(0, 0, 0))(
+                z, data, rngs)
+            if pushsum:
+                # fold the gradient step back into the biased iterate, then
+                # push x and omega with the column-stochastic matrix
+                delta = jax.tree.map(lambda a, b: a - b, new_z, z)
+                x = jax.tree.map(
+                    lambda xv, d: xv + d * omega.reshape(
+                        (-1,) + (1,) * (d.ndim - 1)), x, delta)
+                x = _gossip(x, W)
+                omega = W.astype(omega.dtype) @ omega
+            else:
+                x = _gossip(new_z, W)
+            return (x, omega), losses.mean()
+
+        (x, omega), losses = jax.lax.scan(
+            body, (stacked0, omega0), jnp.arange(iters))
+        z = jax.tree.map(
+            lambda a: a / omega.reshape((-1,) + (1,) * (a.ndim - 1)), x
+        ) if pushsum else x
+        return z, losses
+
+    return jax.jit(run, static_argnames="iters")
+
+
+def consensus_distance(stacked: Pytree) -> float:
+    """Mean squared distance of each client's params to the client mean —
+    the convergence-of-consensus metric (0 == full agreement)."""
+    leaves = jax.tree.leaves(stacked)
+    tot, cnt = 0.0, 0
+    for a in leaves:
+        mean = a.mean(0, keepdims=True)
+        tot += float(jnp.sum((a - mean) ** 2))
+        cnt += int(np.prod(a.shape[1:])) * a.shape[0]
+    return tot / max(cnt, 1)
+
+
+def run_dsgd(apply_fn: Callable, params0: Pytree, data: dict,
+             topology: Optional[SymmetricTopologyManager] = None,
+             iters: int = 100, lr: float = 0.1, batch_size: int = 8,
+             weight_decay: float = 0.0, neighbor_num: int = 2,
+             seed: int = 0):
+    """Decentralized SGD over an undirected gossip graph (reference:
+    client_dsgd.py). Returns (stacked final params [N, ...], loss curve).
+    params0 may be a single pytree (replicated to all clients) or already
+    stacked."""
+    n = data["y"].shape[0]
+    topo = topology or SymmetricTopologyManager(n, neighbor_num=neighbor_num)
+    W = jnp.asarray(topo.topology, jnp.float32)
+    stacked = _ensure_stacked(params0, n)
+    run = _build_run(apply_fn, W, lr, batch_size, weight_decay,
+                     pushsum=False)
+    return run(stacked, _with_mask(data), jax.random.key(seed), iters)
+
+
+def run_pushsum(apply_fn: Callable, params0: Pytree, data: dict,
+                topology: Optional[AsymmetricTopologyManager] = None,
+                iters: int = 100, lr: float = 0.1, batch_size: int = 8,
+                weight_decay: float = 0.0, in_num: int = 2, out_num: int = 1,
+                seed: int = 0):
+    """PushSum over a directed gossip graph (reference: client_pushsum.py):
+    column-stochastic pushes + omega de-biasing, so consensus converges to
+    the uniform average even though the digraph is not doubly stochastic."""
+    n = data["y"].shape[0]
+    topo = topology or AsymmetricTopologyManager(n, in_num=in_num,
+                                                 out_num=out_num)
+    P = jnp.asarray(column_stochastic(topo.topology), jnp.float32)
+    stacked = _ensure_stacked(params0, n)
+    run = _build_run(apply_fn, P, lr, batch_size, weight_decay,
+                     pushsum=True)
+    return run(stacked, _with_mask(data), jax.random.key(seed), iters)
+
+
+def _ensure_stacked(params: Pytree, n: int) -> Pytree:
+    leaves = jax.tree.leaves(params)
+    if leaves and hasattr(leaves[0], "shape") and leaves[0].shape[:1] == (n,):
+        return params
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n,) + a.shape).copy(), params)
+
+
+def _with_mask(data: dict) -> dict:
+    if "mask" not in data:
+        data = dict(data)
+        data["mask"] = jnp.ones(data["y"].shape[:2], jnp.float32)
+    return {k: jnp.asarray(v) for k, v in data.items()}

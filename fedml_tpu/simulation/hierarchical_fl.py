@@ -1,0 +1,97 @@
+"""Two-tier hierarchical FL (HierFL): group rounds inside global rounds.
+
+(reference: simulation/sp/hierarchical_fl/trainer.py:10 — clients are
+assigned to groups (random), each global round every group runs
+`group_comm_round` local FedAvg rounds among its sampled clients
+(group.py:train), then the server averages the group models weighted by
+group sample counts. Distinct from cross-silo hierarchical (one silo = one
+trainer with intra-silo data parallelism): here BOTH tiers are FedAvg.)
+
+TPU design: the inner tier reuses the flat round engine (parallel/round.py)
+— one jitted program per group round with the group's sampled clients as
+ids; the outer tier is a weighted tree-mean of group states. No new device
+code: the hierarchy is pure composition of the existing round program.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algorithm import FedAlgorithm
+from ..ops import tree as tu
+from ..parallel.round import build_round_fn
+
+Pytree = Any
+
+
+def assign_groups(n_clients: int, n_groups: int, method: str = "random",
+                  seed: int = 0) -> list[np.ndarray]:
+    """Client -> group assignment (reference: trainer.py group_method ==
+    'random'; np.random.randint over groups)."""
+    if method != "random":
+        raise ValueError(f"unknown group_method {method!r} (reference "
+                         "supports 'random')")
+    rs = np.random.RandomState(seed)
+    idx = rs.randint(0, n_groups, n_clients)
+    groups = [np.where(idx == g)[0].astype(np.int32)
+              for g in range(n_groups)]
+    return [g for g in groups if g.size]   # drop empty groups
+
+
+class HierFLRunner:
+    """Global rounds of (per-group FedAvg sub-rounds -> weighted merge)."""
+
+    def __init__(self, alg: FedAlgorithm, params: Pytree, data: dict,
+                 counts: np.ndarray, n_groups: int = 2,
+                 group_comm_round: int = 2,
+                 clients_per_group_round: Optional[int] = None,
+                 seed: int = 0):
+        self.alg = alg
+        self.data = {k: jnp.asarray(v) for k, v in data.items()}
+        self.counts = np.asarray(counts, np.float32)
+        self.groups = assign_groups(len(counts), n_groups, seed=seed)
+        self.group_comm_round = group_comm_round
+        self.m = clients_per_group_round
+        self.seed = seed
+        self.params = params
+        self.round_fn = build_round_fn(alg, mesh=None)
+        self.history: list[dict] = []
+
+    def _sample(self, group: np.ndarray, global_r: int, sub_r: int):
+        m = self.m or len(group)
+        if m >= len(group):
+            return group
+        rs = np.random.RandomState(self.seed + 1000 * global_r + sub_r)
+        return np.sort(rs.choice(group, m, replace=False)).astype(np.int32)
+
+    def run(self, global_rounds: int) -> list[dict]:
+        for R in range(global_rounds):
+            group_params, group_weights, losses = [], [], []
+            for gi, group in enumerate(self.groups):
+                # each group starts the global round from the global model
+                st = self.alg.server_init(
+                    jax.tree.map(jnp.array, self.params), None)
+                for r in range(self.group_comm_round):
+                    ids = self._sample(group, R, r)
+                    w = jnp.asarray(self.counts[ids])
+                    rng = jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.fold_in(
+                                jax.random.key(self.seed), R), gi), r)
+                    # fresh placeholder per call: the engine donates it
+                    out = self.round_fn(
+                        st, jnp.zeros((len(self.counts),)), self.data,
+                        jnp.asarray(ids), w, rng, None)
+                    st = out.server_state
+                    losses.append(float(out.metrics["train_loss"]))
+                group_params.append(st.params)
+                group_weights.append(float(self.counts[group].sum()))
+            stacked = tu.tree_stack(group_params)
+            self.params = tu.tree_weighted_mean(
+                stacked, jnp.asarray(group_weights))
+            self.history.append(
+                {"round": R, "train_loss": float(np.mean(losses))})
+        return self.history

@@ -286,12 +286,10 @@ class Simulator:
         self.history = list(history)
         rounds_done = r + 1
         if self.dp.enabled and self.dp.accountant is not None:
-            # fast-forward only the MISSING compositions: this instance may
-            # already have stepped the accountant (restore-to-extend on a
-            # live Simulator)
-            missing = rounds_done - self.dp.accountant.steps
-            if missing > 0:
-                self.dp.accountant.step(missing)
+            # the accountant must reflect exactly the restored number of
+            # compositions — whether this instance is fresh (fast-forward)
+            # or live and rolling BACK to an earlier checkpoint
+            self.dp.accountant.steps = rounds_done
         return rounds_done
 
     def run(self, num_rounds: Optional[int] = None,

@@ -1,0 +1,121 @@
+"""Split learning (SplitNN) — model split at a cut layer between client and
+server.
+
+(reference: simulation/mpi/split_nn/SplitNNAPI.py:10-44 splits a torch model
+into client bottom / server top; client.py + server.py exchange activations
+and activation-gradients over MPI; clients train in a relay ring, handing
+the bottom weights to the next client.)
+
+TPU design: the communication boundary is preserved EXACTLY — the server
+never sees client params or raw data, the client never sees labels' loss
+internals, only dL/dh comes back:
+
+    client:  h, vjp = jax.vjp(bottom_apply, client_params)   (activations up)
+    server:  (loss, (server_grads, dh)) = value_and_grad over (sp, h)
+    client:  client_grads = vjp(dh)                            (grads down)
+
+Both directions are jitted; `jax.vjp` at the cut IS the activation-gradient
+protocol, with none of the reference's manual autograd bookkeeping. The
+relay ring (client k hands bottom weights to client k+1, reference
+client_manager.py) becomes a fold over the stacked client shards.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.algorithm import make_batch_indices, masked_softmax_ce
+
+Pytree = Any
+
+
+def make_split_step(client_apply: Callable, server_apply: Callable,
+                    client_opt: optax.GradientTransformation,
+                    server_opt: optax.GradientTransformation):
+    """One batch of split training; jitted by the runner. The boundary
+    values (h up, dh down) are the ONLY cross-party tensors."""
+
+    def step(cp, sp, c_opt_state, s_opt_state, batch):
+        # --- client: forward to the cut
+        h, vjp = jax.vjp(
+            lambda p: client_apply({"params": p}, batch["x"]), cp)
+
+        # --- server: loss + grads wrt (its params, the activations)
+        def server_loss(p, hh):
+            logits = server_apply({"params": p}, hh)
+            loss, correct, cnt = masked_softmax_ce(
+                logits, batch["y"], batch["mask"])
+            return loss, (correct, cnt)
+
+        (loss, (correct, cnt)), (s_grads, dh) = jax.value_and_grad(
+            server_loss, argnums=(0, 1), has_aux=True)(sp, h)
+        s_updates, s_opt_state = server_opt.update(s_grads, s_opt_state, sp)
+        sp = optax.apply_updates(sp, s_updates)
+
+        # --- client: backward from the cut
+        (c_grads,) = vjp(dh)
+        c_updates, c_opt_state = client_opt.update(c_grads, c_opt_state, cp)
+        cp = optax.apply_updates(cp, c_updates)
+        return cp, sp, c_opt_state, s_opt_state, (loss, correct, cnt)
+
+    return step
+
+
+class SplitNNRunner:
+    """Relay-ring split training (reference: SplitNNAPI.py + the
+    client/server managers): clients take turns; each trains `epochs` local
+    epochs against the shared server top, then relays the bottom weights."""
+
+    def __init__(self, client_net, server_net, data: dict,
+                 lr: float = 0.1, batch_size: int = 16, epochs: int = 1,
+                 seed: int = 0):
+        self.client_net, self.server_net = client_net, server_net
+        self.data = {k: jnp.asarray(v) for k, v in data.items()}
+        if "mask" not in self.data:
+            self.data["mask"] = jnp.ones(self.data["y"].shape, jnp.float32)
+        self.n_clients = int(self.data["y"].shape[0])
+        self.batch_size, self.epochs, self.seed = batch_size, epochs, seed
+
+        x0 = self.data["x"][0, :1]
+        self.client_params = client_net.init(jax.random.key(seed), x0)["params"]
+        h0 = client_net.apply({"params": self.client_params}, x0)
+        self.server_params = server_net.init(
+            jax.random.key(seed + 1), h0)["params"]
+        self.c_opt = optax.sgd(lr)
+        self.s_opt = optax.sgd(lr)
+        self._step = jax.jit(make_split_step(
+            client_net.apply, server_net.apply, self.c_opt, self.s_opt))
+        self.history: list[dict] = []
+
+    def run(self, rounds: int = 1) -> list[dict]:
+        cp, sp = self.client_params, self.server_params
+        c_state, s_state = self.c_opt.init(cp), self.s_opt.init(sp)
+        for r in range(rounds):
+            for k in range(self.n_clients):   # the relay ring
+                shard = {key: v[k] for key, v in self.data.items()}
+                s = int(shard["y"].shape[0])
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(self.seed), r), k)
+                idx = make_batch_indices(rng, s, self.batch_size, self.epochs)
+                tot = np.zeros(3)
+                for b in range(idx.shape[0]):
+                    batch = {key: v[idx[b]] for key, v in shard.items()}
+                    cp, sp, c_state, s_state, (l, c, n) = self._step(
+                        cp, sp, c_state, s_state, batch)
+                    tot += [float(l) * float(n), float(c), float(n)]
+                self.history.append({
+                    "round": r, "client": k,
+                    "loss": tot[0] / max(tot[2], 1),
+                    "acc": tot[1] / max(tot[2], 1)})
+        self.client_params, self.server_params = cp, sp
+        return self.history
+
+    def predict(self, x) -> jnp.ndarray:
+        h = self.client_net.apply({"params": self.client_params},
+                                  jnp.asarray(x))
+        return jnp.argmax(
+            self.server_net.apply({"params": self.server_params}, h), -1)

@@ -1,0 +1,110 @@
+"""Split learning, vertical FL, two-tier HierFL (reference:
+simulation/mpi/split_nn/, simulation/sp/classical_vertical_fl/,
+simulation/sp/hierarchical_fl/)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.builtin import make_fedavg
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.models import hub
+from fedml_tpu.simulation.hierarchical_fl import HierFLRunner, assign_groups
+from fedml_tpu.simulation.split_nn import SplitNNRunner
+from fedml_tpu.simulation.vertical import VerticalFL
+
+
+class Bottom(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(16)(x))
+
+
+class Top(nn.Module):
+    num_classes: int = 3
+
+    @nn.compact
+    def __call__(self, h):
+        return nn.Dense(self.num_classes)(nn.relu(nn.Dense(16)(h)))
+
+
+def _clients_data(n_clients=3, s=64, d=8, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, k)
+    x = rs.randn(n_clients, s, d).astype(np.float32)
+    y = np.argmax(x @ w, axis=-1).astype(np.int32)
+    return {"x": x, "y": y}
+
+
+# ------------------------------------------------------------------ split NN
+def test_splitnn_trains_and_split_boundary_holds():
+    data = _clients_data()
+    runner = SplitNNRunner(Bottom(), Top(3), data, lr=0.2, batch_size=16,
+                           epochs=2)
+    hist = runner.run(rounds=3)
+    first = np.mean([h["loss"] for h in hist[:3]])
+    last = np.mean([h["loss"] for h in hist[-3:]])
+    assert last < first * 0.5, (first, last)
+    acc = float((runner.predict(data["x"][0]) == data["y"][0]).mean())
+    assert acc > 0.8
+    # the relay trained every client
+    assert {h["client"] for h in hist} == {0, 1, 2}
+
+
+# ---------------------------------------------------------------- vertical FL
+def test_vertical_fl_three_parties():
+    rs = np.random.RandomState(1)
+    n, d1, d2, d3 = 400, 5, 4, 3
+    xs = [rs.randn(n, d).astype(np.float32) for d in (d1, d2, d3)]
+    w_true = [rs.randn(d) for d in (d1, d2, d3)]
+    logit = sum(x @ w for x, w in zip(xs, w_true))
+    y = (logit > 0).astype(np.float32)
+
+    vfl = VerticalFL([d1, d2, d3], lr=0.5)
+    vfl.fit(xs, y, epochs=20, batch_size=64)
+    assert vfl.loss_trace[-1] < vfl.loss_trace[0] * 0.4
+    acc = (vfl.predict(xs) == y.astype(np.int32)).mean()
+    assert acc > 0.9, acc
+
+
+def test_vertical_fl_needs_all_parties():
+    """Dropping a party's features must hurt: the label depends on every
+    slice (the point of vertical federation)."""
+    rs = np.random.RandomState(2)
+    n = 400
+    xs = [rs.randn(n, 4).astype(np.float32) for _ in range(2)]
+    w = [rs.randn(4) * 3 for _ in range(2)]
+    y = ((xs[0] @ w[0] + xs[1] @ w[1]) > 0).astype(np.float32)
+    full = VerticalFL([4, 4], lr=0.5)
+    full.fit(xs, y, epochs=15)
+    acc_full = (full.predict(xs) == y.astype(np.int32)).mean()
+    solo = VerticalFL([4], lr=0.5)
+    solo.fit(xs[:1], y, epochs=15)
+    acc_solo = (solo.predict(xs[:1]) == y.astype(np.int32)).mean()
+    assert acc_full > acc_solo + 0.1, (acc_full, acc_solo)
+
+
+# ------------------------------------------------------------------- HierFL
+def test_assign_groups_partition():
+    groups = assign_groups(20, 4, seed=0)
+    allc = np.concatenate(groups)
+    assert sorted(allc.tolist()) == list(range(20))
+
+
+def test_hierfl_two_tier_convergence():
+    n_clients, s = 8, 48
+    data = _clients_data(n_clients=n_clients, s=s, seed=3)
+    data["mask"] = np.ones((n_clients, s), np.float32)
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.3)
+    alg = make_fedavg(model.apply, t)
+    params = hub.init_params(model, (8,), jax.random.key(0))
+    runner = HierFLRunner(alg, params, data,
+                          counts=np.full(n_clients, float(s)),
+                          n_groups=3, group_comm_round=2, seed=5)
+    hist = runner.run(global_rounds=5)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"] * 0.6
+    # global model classifies client 0's data
+    logits = model.apply({"params": runner.params}, jnp.asarray(data["x"][0]))
+    acc = float((jnp.argmax(logits, -1) == jnp.asarray(data["y"][0])).mean())
+    assert acc > 0.8
