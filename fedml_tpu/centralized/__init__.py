@@ -1,0 +1,129 @@
+"""Centralized (non-federated) baseline trainer.
+
+(reference: python/fedml/centralized/centralized_trainer.py — 164 LoC torch
+loop over the pooled dataset; exists so federated results can be compared
+against ordinary training on the same data/model/optimizer.)
+
+TPU design: pool the stacked client shards, then one jitted lax.scan epoch
+(core/algorithm.local_sgd is exactly that loop) — the baseline uses the
+same hot path the federated engine uses, so perf/accuracy comparisons
+isolate the FEDERATION, not implementation differences.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import optax
+
+from ..config import Config
+from ..core.algorithm import (
+    eval_step_fn, make_batch_indices, make_client_optimizer,
+    masked_softmax_ce,
+)
+from ..data.fed_dataset import FedDataset
+from ..models import hub as model_hub
+from ..utils.events import recorder
+
+Pytree = Any
+
+
+def pool_clients(dataset: FedDataset) -> dict:
+    """Concatenate the stacked [N, S, ...] client shards into one pooled
+    shard, dropping padding rows via the mask."""
+    x = np.asarray(dataset.x_train).reshape(
+        (-1,) + dataset.x_train.shape[2:])
+    y = np.asarray(dataset.y_train).reshape(-1)
+    m = np.asarray(dataset.mask_train).reshape(-1)
+    keep = m > 0
+    return {"x": x[keep], "y": y[keep],
+            "mask": np.ones(int(keep.sum()), np.float32)}
+
+
+class CentralizedTrainer:
+    """Plain SGD on pooled data (reference: centralized_trainer.py)."""
+
+    def __init__(self, cfg: Config, dataset: Optional[FedDataset] = None,
+                 model=None):
+        from ..data import loader as data_loader
+
+        self.cfg = cfg
+        t = cfg.train_args
+        self.dataset = dataset if dataset is not None else data_loader.load(cfg)
+        self.model = model if model is not None else model_hub.create(
+            cfg.model_args.model, self.dataset.num_classes,
+            **cfg.model_args.extra)
+        self.apply_fn = model_hub.mixed_precision_apply(
+            self.model.apply, t.compute_dtype)
+        self.params = model_hub.init_params(
+            self.model, self.dataset.x_train.shape[2:],
+            jax.random.key(cfg.common_args.random_seed))
+        self.pooled = {k: jnp.asarray(v)
+                       for k, v in pool_clients(self.dataset).items()}
+        self.opt = make_client_optimizer(
+            t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay)
+        # optimizer state persists ACROSS epochs (momentum/Adam moments
+        # must not reset at epoch boundaries — this is ordinary training)
+        self.opt_state = self.opt.init(self.params)
+        self._train = jax.jit(self._epoch)
+        self._eval = jax.jit(eval_step_fn(self.apply_fn))
+        self.history: list[dict] = []
+
+    def _epoch(self, params, opt_state, rng):
+        t = self.cfg.train_args
+        idx = make_batch_indices(
+            rng, self.pooled["y"].shape[0], t.batch_size, 1)
+        data = self.pooled
+        opt = self.opt
+        apply_fn = self.apply_fn
+
+        def step(carry, bi):
+            p, s = carry
+            batch = {k: v[bi] for k, v in data.items()}
+
+            def loss_fn(pp):
+                logits = apply_fn({"params": pp}, batch["x"])
+                loss, correct, cnt = masked_softmax_ce(
+                    logits, batch["y"], batch["mask"])
+                return loss, (correct, cnt)
+
+            (loss, (correct, cnt)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p)
+            updates, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), (loss * cnt, correct, cnt)
+
+        (params, opt_state), (ls, cs, ns) = jax.lax.scan(
+            step, (params, opt_state), idx)
+        return params, opt_state, (ls.sum(), cs.sum(), ns.sum())
+
+    def evaluate(self) -> dict:
+        from ..simulation.simulator import _pad_test_batches
+
+        t = self.cfg.train_args
+        xb, yb, mb = _pad_test_batches(
+            self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64))
+        m = jax.device_get(self._eval(
+            self.params, jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb)))
+        return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+
+    def run(self, epochs: Optional[int] = None) -> list[dict]:
+        t = self.cfg.train_args
+        n_epochs = epochs if epochs is not None else t.epochs
+        for e in range(n_epochs):
+            rng = jax.random.fold_in(
+                jax.random.key(self.cfg.common_args.random_seed), e)
+            with recorder.span("centralized_epoch", epoch=e):
+                self.params, self.opt_state, (lsum, correct, cnt) = \
+                    self._train(self.params, self.opt_state, rng)
+            n = max(float(cnt), 1.0)
+            row = {"epoch": e, "train_loss": float(lsum) / n,
+                   "train_acc": float(correct) / n}
+            if e == n_epochs - 1:
+                row.update(self.evaluate())
+            self.history.append(row)
+            recorder.log(row)
+        return self.history

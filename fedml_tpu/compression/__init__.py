@@ -208,3 +208,33 @@ def decode_sparse(enc: dict) -> np.ndarray:
     out = np.zeros(enc["n"], np.float32)
     out[enc["idx"]] = enc["val"]
     return out
+
+
+def encode_sparse_tree(tree, ratio: float) -> dict:
+    """Per-leaf sparse encoding of a pytree update (the cross-device uplink
+    payload: top-k per leaf, flat order = jax.tree.leaves)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    return {"leaves": [encode_sparse(np.asarray(l), ratio) for l in leaves]}
+
+
+def decode_sparse_tree(enc: dict, template) -> "object":
+    """Inverse of encode_sparse_tree; `template` supplies structure+shapes.
+    Raises on leaf-count or size mismatch (a silent zip-truncation would
+    aggregate a structurally wrong update into the global model)."""
+    import jax
+
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(enc["leaves"]) != len(t_leaves):
+        raise ValueError(
+            f"sparse payload has {len(enc['leaves'])} leaves, template has "
+            f"{len(t_leaves)} (model-version mismatch?)")
+    out = []
+    for tl, el in zip(t_leaves, enc["leaves"]):
+        n = int(np.size(tl))
+        if int(el["n"]) != n or np.any(np.asarray(el["idx"]) >= n) or \
+                np.any(np.asarray(el["idx"]) < 0):
+            raise ValueError("sparse leaf indices out of range for template")
+        out.append(decode_sparse(el).reshape(np.shape(tl)))
+    return jax.tree_util.tree_unflatten(treedef, out)

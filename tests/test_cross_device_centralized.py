@@ -1,0 +1,118 @@
+"""Cross-device runtime (dynamic registry, flaky devices, sparse uplink) +
+centralized baseline (reference: python/fedml/cross_device/, centralized/)."""
+import threading
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.comm import FedCommManager
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.centralized import CentralizedTrainer
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.cross_device import CrossDeviceServer, EdgeClient
+from fedml_tpu.cross_silo import SiloTrainer
+from fedml_tpu.compression import decode_sparse_tree, encode_sparse_tree
+from fedml_tpu.models import hub
+
+
+def _mk_data(seed, n=48, d=8, k=3):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def test_sparse_tree_roundtrip_topk():
+    model = hub.create("lr", 3)
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    enc = encode_sparse_tree(params, ratio=1.0)   # keep everything
+    dec = decode_sparse_tree(enc, params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 params, dec)
+
+
+def _launch(n_devices, num_rounds, run_id, uplink_topk=None, flaky=None,
+            round_timeout=6.0, devices_per_round=None, min_devices=None):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    server = CrossDeviceServer(
+        FedCommManager(LoopbackTransport(0, run_id), 0),
+        init_params=params, num_rounds=num_rounds,
+        devices_per_round=devices_per_round or n_devices,
+        min_devices=min_devices or n_devices,
+        round_timeout=round_timeout)
+    clients = []
+    for did in range(1, n_devices + 1):
+        tr = SiloTrainer(model.apply, t, *_mk_data(did), seed=did)
+        tr.train(params, 0)   # warm jit outside the round deadline
+        if flaky is not None:
+            tr = flaky(did, tr)
+        clients.append(EdgeClient(
+            FedCommManager(LoopbackTransport(did, run_id), did), did, tr,
+            uplink_topk=uplink_topk,
+            device_info={"os": "test", "mem_mb": 512}))
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+    for c in clients:
+        c.register()
+    assert server.done.wait(timeout=120), "cross-device run did not finish"
+    release_router(run_id)
+    return server, model
+
+
+def test_cross_device_dense_rounds():
+    server, model = _launch(3, 3, f"cd-{uuid.uuid4().hex[:6]}")
+    assert len(server.history) == 3
+    assert all(h["n_received"] == 3 for h in server.history)
+
+
+def test_cross_device_sparse_uplink():
+    server, _ = _launch(2, 2, f"cd-{uuid.uuid4().hex[:6]}", uplink_topk=0.5)
+    assert len(server.history) == 2
+    leaves = jax.tree.leaves(server.params)
+    assert all(np.isfinite(l).all() for l in leaves)
+
+
+class _DieAfterRound0:
+    def __init__(self, inner):
+        self.inner = inner
+
+    def train(self, params, r):
+        if r >= 1:
+            threading.Event().wait()
+        return self.inner.train(params, r)
+
+
+def test_cross_device_flaky_device_dropped_from_registry():
+    def flaky(did, tr):
+        return _DieAfterRound0(tr) if did == 3 else tr
+
+    server, _ = _launch(3, 3, f"cd-{uuid.uuid4().hex[:6]}", flaky=flaky,
+                        round_timeout=4.0)
+    assert len(server.history) == 3
+    assert server.dropped_log and server.dropped_log[0][1] == [3]
+    # dead device evicted from the registry; later rounds ran without it
+    assert server.history[-1]["n_online"] == 2
+    assert server.history[-1]["n_received"] == 2
+
+
+def test_centralized_baseline_converges():
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 64}},
+        "model_args": {"model": "lr"},
+        "train_args": {"client_num_in_total": 4, "client_num_per_round": 4,
+                       "epochs": 1, "batch_size": 16, "learning_rate": 0.3},
+    })
+    tr = CentralizedTrainer(cfg)
+    hist = tr.run(epochs=10)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"] * 0.8
+    assert hist[-1]["train_acc"] > hist[0]["train_acc"]
