@@ -60,6 +60,9 @@ def _make_client_sgd(apply_fn, t: TrainArgs, grad_correction_factory=None):
     opt = make_client_optimizer(
         t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
     )
+    from ..core.algorithm import make_objective
+
+    objective = make_objective(t.extra.get("task"))
 
     def run(bcast, shard, client_state, rng):
         idx = make_batch_indices(rng, shard["y"].shape[0], t.batch_size, t.epochs)
@@ -69,7 +72,8 @@ def _make_client_sgd(apply_fn, t: TrainArgs, grad_correction_factory=None):
             else None
         )
         new_params, metrics, tau = local_sgd(
-            apply_fn, bcast["params"], shard, idx, opt, corr
+            apply_fn, bcast["params"], shard, idx, opt, corr,
+            objective=objective,
         )
         delta = tu.tree_sub(new_params, bcast["params"])
         return delta, metrics, tau
@@ -170,6 +174,9 @@ def make_scaffold(apply_fn, t: TrainArgs, client_num_in_total: int,
         t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
     )
     frac = client_num_per_round / max(client_num_in_total, 1)
+    from ..core.algorithm import make_objective
+
+    objective = make_objective(t.extra.get("task"))
 
     def corr_factory(bcast, client_state):
         c = bcast["extra"]
@@ -183,7 +190,8 @@ def make_scaffold(apply_fn, t: TrainArgs, client_num_in_total: int,
         idx = make_batch_indices(rng, shard["y"].shape[0], t.batch_size, t.epochs)
         corr = corr_factory(bcast, client_state)
         new_params, metrics, tau = local_sgd(
-            apply_fn, bcast["params"], shard, idx, base_opt, corr
+            apply_fn, bcast["params"], shard, idx, base_opt, corr,
+            objective=objective,
         )
         delta = tu.tree_sub(new_params, bcast["params"])
         k_lr = jnp.maximum(tau, 1.0) * t.learning_rate
@@ -252,6 +260,9 @@ def make_mime(apply_fn, t: TrainArgs) -> FedAlgorithm:
     server refreshes momentum from the mean full-batch gradient at the global
     params (reference: sp/mime/)."""
     beta = t.mime_beta
+    from ..core.algorithm import make_objective
+
+    objective = make_objective(t.extra.get("task"))
 
     def server_init(params, _cfg=None):
         return ServerState(
@@ -269,15 +280,15 @@ def make_mime(apply_fn, t: TrainArgs) -> FedAlgorithm:
             return tu.tree_add(tu.tree_scale(m, beta), tu.tree_scale(g, 1.0 - beta))
 
         new_params, metrics, _ = local_sgd(
-            apply_fn, bcast["params"], shard, idx, mom_opt, corr
+            apply_fn, bcast["params"], shard, idx, mom_opt, corr,
+            objective=objective,
         )
         delta = tu.tree_sub(new_params, bcast["params"])
 
         # full-batch gradient at the GLOBAL params for the momentum refresh
         def loss_fn(p):
-            from ..core.algorithm import masked_softmax_ce
             logits = apply_fn({"params": p}, shard["x"])
-            loss, _, _ = masked_softmax_ce(logits, shard["y"], shard["mask"])
+            loss, _, _ = objective(logits, shard["y"], shard["mask"])
             return loss
 
         full_grad = jax.grad(loss_fn)(bcast["params"])

@@ -1,0 +1,117 @@
+"""FedGAN — federated adversarial training (Rasouli et al. 2020).
+
+(reference: simulation/mpi/fedgan/ — 11 files of MPI process managers
+alternating local D/G steps and FedAvg-ing both networks every sync
+interval.)
+
+TPU design: a FedGAN client update is a pure step function like every other
+algorithm — the payload is a {"g": ..., "d": ...} delta pair, so the
+EXISTING round engine (parallel/round.py), compression, DP, and defenses
+all apply unchanged. Local training is a lax.scan of alternating
+discriminator/generator non-saturating GAN steps.
+
+Client data: shard["x"] = real images [S, H, W, C] scaled to (-1, 1);
+shard["y"]/["mask"] follow the engine's layout (y unused, mask marks real
+rows).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import TrainArgs
+from ..core.algorithm import ClientMetrics, FedAlgorithm, ServerState
+from ..core.registry import ALGORITHMS
+from ..ops import tree as tu
+
+Pytree = Any
+
+
+def _bce_logits(logits, target):
+    return optax.sigmoid_binary_cross_entropy(
+        logits, jnp.full_like(logits, target)).mean()
+
+
+def make_fedgan(models: dict, t: TrainArgs, latent: int = 64,
+                d_steps: int = 1) -> FedAlgorithm:
+    """models: {"generator": flax Module, "discriminator": flax Module}
+    (the model-hub "gan" entry). Client update runs `epochs * steps`
+    alternating D/G minibatch steps; aggregation is the engine's weighted
+    mean over both networks at once."""
+    gen, disc = models["generator"], models["discriminator"]
+    g_opt = optax.adam(t.learning_rate, b1=0.5)
+    d_opt = optax.adam(t.learning_rate, b1=0.5)
+
+    def server_init(params: Pytree, _cfg=None) -> ServerState:
+        return ServerState(params, None, jnp.int32(0), None)
+
+    def client_update(bcast, shard, client_state, rng):
+        p = bcast["params"]
+        gp, dp_ = p["g"], p["d"]
+        g_state, d_state = g_opt.init(gp), d_opt.init(dp_)
+        s = shard["x"].shape[0]
+        bs = min(t.batch_size, s)
+        n_steps = t.epochs * max(1, s // bs)
+
+        def step(carry, i):
+            gp, dp_, gs, ds = carry
+            r1 = jax.random.fold_in(rng, 2 * i)
+            r2 = jax.random.fold_in(rng, 2 * i + 1)
+            idx = jax.random.choice(r1, s, (bs,), replace=False)
+            real = shard["x"][idx]
+            m = shard["mask"][idx]
+
+            def d_loss(dparams):
+                z = jax.random.normal(r2, (bs, latent))
+                fake = gen.apply({"params": gp}, z)
+                lr_ = disc.apply({"params": dparams}, real)
+                lf = disc.apply({"params": dparams}, fake)
+                # mask padded rows out of the real-term mean
+                real_term = (optax.sigmoid_binary_cross_entropy(
+                    lr_, jnp.ones_like(lr_)) * m).sum() / jnp.maximum(
+                        m.sum(), 1.0)
+                return real_term + _bce_logits(lf, 0.0)
+
+            dl, dgrads = jax.value_and_grad(d_loss)(dp_)
+            du, ds = d_opt.update(dgrads, ds, dp_)
+            dp_ = optax.apply_updates(dp_, du)
+
+            def g_loss(gparams):
+                z = jax.random.normal(
+                    jax.random.fold_in(r2, 7), (bs, latent))
+                fake = gen.apply({"params": gparams}, z)
+                return _bce_logits(disc.apply({"params": dp_}, fake), 1.0)
+
+            gl, ggrads = jax.value_and_grad(g_loss)(gp)
+            gu, gs = g_opt.update(ggrads, gs, gp)
+            gp = optax.apply_updates(gp, gu)
+            return (gp, dp_, gs, ds), (dl + gl, m.sum())
+
+        (gp, dp_, _, _), (losses, counts) = jax.lax.scan(
+            step, (gp, dp_, g_state, d_state), jnp.arange(n_steps))
+        delta = {"g": tu.tree_sub(gp, p["g"]), "d": tu.tree_sub(dp_, p["d"])}
+        metrics = ClientMetrics(
+            (losses * counts).sum(), jnp.zeros(()), counts.sum())
+        return delta, client_state, metrics
+
+    def server_update(st: ServerState, mean_delta: Pytree) -> ServerState:
+        params = tu.tree_add(st.params, mean_delta)
+        return st.replace(params=params, round=st.round + 1)
+
+    return FedAlgorithm("FedGAN", server_init, client_update, server_update)
+
+
+def init_gan_params(models: dict, img_shape: tuple, rng: jax.Array,
+                    latent: int = 64) -> dict:
+    g_rng, d_rng = jax.random.split(rng)
+    gp = models["generator"].init(
+        g_rng, jnp.zeros((1, latent)))["params"]
+    dp_ = models["discriminator"].init(
+        d_rng, jnp.zeros((1,) + tuple(img_shape)))["params"]
+    return {"g": gp, "d": dp_}
+
+
+ALGORITHMS.register("FedGAN")(make_fedgan)

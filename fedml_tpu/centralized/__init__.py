@@ -17,12 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import optax
-
 from ..config import Config
 from ..core.algorithm import (
-    eval_step_fn, make_batch_indices, make_client_optimizer,
-    masked_softmax_ce,
+    eval_step_fn, local_sgd, make_batch_indices, make_client_optimizer,
+    make_objective,
 )
 from ..data.fed_dataset import FedDataset
 from ..models import hub as model_hub
@@ -68,37 +66,21 @@ class CentralizedTrainer:
         # optimizer state persists ACROSS epochs (momentum/Adam moments
         # must not reset at epoch boundaries — this is ordinary training)
         self.opt_state = self.opt.init(self.params)
+        self.objective = make_objective(t.extra.get("task"))
         self._train = jax.jit(self._epoch)
-        self._eval = jax.jit(eval_step_fn(self.apply_fn))
+        self._eval = jax.jit(eval_step_fn(self.apply_fn, self.objective))
         self.history: list[dict] = []
 
     def _epoch(self, params, opt_state, rng):
         t = self.cfg.train_args
         idx = make_batch_indices(
             rng, self.pooled["y"].shape[0], t.batch_size, 1)
-        data = self.pooled
-        opt = self.opt
-        apply_fn = self.apply_fn
-
-        def step(carry, bi):
-            p, s = carry
-            batch = {k: v[bi] for k, v in data.items()}
-
-            def loss_fn(pp):
-                logits = apply_fn({"params": pp}, batch["x"])
-                loss, correct, cnt = masked_softmax_ce(
-                    logits, batch["y"], batch["mask"])
-                return loss, (correct, cnt)
-
-            (loss, (correct, cnt)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(p)
-            updates, s = opt.update(grads, s, p)
-            p = optax.apply_updates(p, updates)
-            return (p, s), (loss * cnt, correct, cnt)
-
-        (params, opt_state), (ls, cs, ns) = jax.lax.scan(
-            step, (params, opt_state), idx)
-        return params, opt_state, (ls.sum(), cs.sum(), ns.sum())
+        params, metrics, _steps, opt_state = local_sgd(
+            self.apply_fn, params, self.pooled, idx, self.opt,
+            objective=self.objective, opt_state=opt_state,
+            return_opt_state=True)
+        return params, opt_state, (metrics.loss_sum, metrics.correct,
+                                   metrics.count)
 
     def evaluate(self) -> dict:
         from ..simulation.simulator import _pad_test_batches

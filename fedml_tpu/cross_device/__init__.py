@@ -69,6 +69,7 @@ class CrossDeviceServer:
         self.aggregator = FedAggregator()
         self.started = False
         self.done = threading.Event()
+        self.error: Optional[str] = None
         self.history: list[dict] = []
         self.dropped_log: list[tuple[int, list[int]]] = []
         self._lock = threading.Lock()
@@ -130,6 +131,19 @@ class CrossDeviceServer:
                                 "rejected", msg.sender_id, exc_info=True)
                     return
                 params = jax.tree.map(np.add, self.params, delta)
+            # dense path: same invariant — a payload that doesn't match the
+            # global model's structure must not reach aggregate()
+            if params is None:
+                log.warning("device %s: model upload without payload "
+                            "rejected", msg.sender_id)
+                return
+            try:
+                jax.tree.map(lambda a, b: np.broadcast_shapes(
+                    np.shape(a), np.shape(b)), self.params, params)
+            except Exception:
+                log.warning("device %s: structurally wrong model rejected",
+                            msg.sender_id)
+                return
             self.aggregator.add_local_trained_result(
                 msg.sender_id, params,
                 float(msg.get(md.KEY_NUM_SAMPLES, 1.0)))
@@ -153,6 +167,15 @@ class CrossDeviceServer:
     def _on_timeout(self, armed_round: int) -> None:
         with self._lock:
             if self.done.is_set() or armed_round != self.round_idx:
+                return
+            if not self.devices and not self.aggregator.results:
+                # every device evicted and nothing received: unrecoverable
+                # (evicted devices were told to finish) — fail loudly
+                log.error("round %d: no devices left in the registry",
+                          self.round_idx)
+                self.error = (f"round {self.round_idx}: all devices "
+                              "dropped — quorum unreachable")
+                self._finish()
                 return
             n_exp = len(self.aggregator.expected)
             quorum = max(1, int(np.ceil(self.quorum_frac * n_exp)))

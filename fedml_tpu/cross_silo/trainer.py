@@ -19,7 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.algorithm import local_sgd, make_batch_indices, make_client_optimizer
+from ..core.algorithm import (
+    local_sgd, make_batch_indices, make_client_optimizer, make_objective,
+)
 from ..config import TrainArgs
 
 Pytree = Any
@@ -59,6 +61,7 @@ class SiloTrainer:
         self.opt = make_client_optimizer(
             t.client_optimizer, t.learning_rate, t.momentum, t.weight_decay
         )
+        self.objective = make_objective(t.extra.get("task"))
         self.seed = seed
         self._jit_train = jax.jit(self._train_impl)
 
@@ -67,7 +70,8 @@ class SiloTrainer:
         idx = make_batch_indices(rng, self.x.shape[0], self.t.batch_size,
                                  self.t.epochs)
         new_params, metrics, _steps = local_sgd(
-            self.apply_fn, params, shard, idx, self.opt
+            self.apply_fn, params, shard, idx, self.opt,
+            objective=self.objective,
         )
         return new_params, metrics
 

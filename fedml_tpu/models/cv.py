@@ -1,0 +1,182 @@
+"""CV model families beyond ResNet: MobileNet v1/v3, EfficientNet-lite, VGG.
+
+(reference: model/model_hub.py:60-67 serves mobilenet / mobilenet_v3 /
+efficientnet from model/cv/{mobilenet,mobilenet_v3,efficientnet}.py, and VGG
+lives in model/cv/vgg.py. Those are torchvision-style BatchNorm models; here
+every norm is GroupNorm — BN running statistics are ill-defined under
+federated averaging (the same reason the reference ships resnet18_gn for its
+FL benchmarks) — and layouts are NHWC with 3x3/1x1 convs that XLA tiles
+directly onto the MXU.)
+
+All classes take `num_classes` plus a width multiplier so tests run tiny
+instances and benchmarks can scale up.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(ch: int) -> nn.GroupNorm:
+    # largest group count <= 32 that divides the channels (width multipliers
+    # produce counts like 72 that 32 doesn't divide)
+    g = min(32, ch)
+    while ch % g:
+        g -= 1
+    return nn.GroupNorm(num_groups=g)
+
+
+class DepthwiseSeparable(nn.Module):
+    """MobileNetV1 block: 3x3 depthwise + 1x1 pointwise."""
+    ch_out: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        ch_in = x.shape[-1]
+        x = nn.Conv(ch_in, (3, 3), (self.strides, self.strides),
+                    feature_group_count=ch_in, use_bias=False)(x)
+        x = nn.relu(_gn(ch_in)(x))
+        x = nn.Conv(self.ch_out, (1, 1), use_bias=False)(x)
+        return nn.relu(_gn(self.ch_out)(x))
+
+
+class MobileNetV1(nn.Module):
+    """reference: model/cv/mobilenet.py (width-multiplied depthwise CNN)."""
+    num_classes: int
+    width: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda c: max(8, int(c * self.width))
+        x = nn.Conv(w(32), (3, 3), (1, 1), use_bias=False)(x)  # cifar stem
+        x = nn.relu(_gn(w(32))(x))
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (1024, 2)]
+        for ch, s in cfg:
+            x = DepthwiseSeparable(w(ch), s)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _hardswish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(8, ch // self.reduce))(s))
+        s = nn.sigmoid(nn.Dense(ch)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    """MobileNetV3 / EfficientNet MBConv: expand -> depthwise -> SE ->
+    project, residual when shapes line up."""
+    ch_out: int
+    expand: int = 4
+    strides: int = 1
+    kernel: int = 3
+    use_se: bool = True
+    act: str = "hswish"   # or "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        act = _hardswish if self.act == "hswish" else nn.relu
+        ch_in = x.shape[-1]
+        ch_mid = ch_in * self.expand
+        h = nn.Conv(ch_mid, (1, 1), use_bias=False)(x)
+        h = act(_gn(ch_mid)(h))
+        h = nn.Conv(ch_mid, (self.kernel, self.kernel),
+                    (self.strides, self.strides),
+                    feature_group_count=ch_mid, use_bias=False)(h)
+        h = act(_gn(ch_mid)(h))
+        if self.use_se:
+            h = SqueezeExcite()(h)
+        h = nn.Conv(self.ch_out, (1, 1), use_bias=False)(h)
+        h = _gn(self.ch_out)(h)
+        if self.strides == 1 and ch_in == self.ch_out:
+            h = h + x
+        return h
+
+
+class MobileNetV3Small(nn.Module):
+    """reference: model/cv/mobilenet_v3.py ('small' profile, GN)."""
+    num_classes: int
+    width: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda c: max(8, int(c * self.width))
+        x = nn.Conv(w(16), (3, 3), (1, 1), use_bias=False)(x)
+        x = _hardswish(_gn(w(16))(x))
+        # (out, expand, stride, kernel, se, act)
+        cfg = [(16, 1, 2, 3, True, "relu"), (24, 4, 2, 3, False, "relu"),
+               (24, 3, 1, 3, False, "relu"), (40, 3, 2, 5, True, "hswish"),
+               (40, 3, 1, 5, True, "hswish"), (48, 3, 1, 5, True, "hswish"),
+               (96, 6, 2, 5, True, "hswish")]
+        for ch, e, s, k, se, a in cfg:
+            x = InvertedResidual(w(ch), e, s, k, se, a)(x)
+        x = nn.Conv(w(576), (1, 1), use_bias=False)(x)
+        x = _hardswish(_gn(w(576))(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = _hardswish(nn.Dense(w(1024))(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class EfficientNetLite(nn.Module):
+    """reference: model/cv/efficientnet.py — lite profile (no SE, relu6),
+    width/depth multipliers."""
+    num_classes: int
+    width: float = 1.0
+    depth: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        import math
+
+        w = lambda c: max(8, int(c * self.width))
+        d = lambda n: max(1, int(math.ceil(n * self.depth)))
+        x = nn.Conv(w(32), (3, 3), (1, 1), use_bias=False)(x)
+        x = nn.relu6(_gn(w(32))(x))
+        # (out, expand, stride, kernel, repeats)
+        cfg = [(16, 1, 1, 3, 1), (24, 6, 2, 3, 2), (40, 6, 2, 5, 2),
+               (80, 6, 2, 3, 3), (112, 6, 1, 5, 3), (192, 6, 2, 5, 4)]
+        for ch, e, s, k, n in cfg:
+            for i in range(d(n)):
+                x = InvertedResidual(w(ch), e, s if i == 0 else 1, k,
+                                     use_se=False, act="relu")(x)
+        x = nn.Conv(w(1280), (1, 1), use_bias=False)(x)
+        x = nn.relu6(_gn(w(1280))(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class VGG(nn.Module):
+    """reference: model/cv/vgg.py (vgg11/16 via stage config, GN not BN)."""
+    num_classes: int
+    stages: Sequence[Sequence[int]] = ((64,), (128,), (256, 256),
+                                       (512, 512), (512, 512))  # vgg11
+    dense: int = 512
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for stage in self.stages:
+            for ch in stage:
+                x = nn.Conv(ch, (3, 3), use_bias=False)(x)
+                x = nn.relu(_gn(ch)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+VGG16_STAGES = ((64, 64), (128, 128), (256, 256, 256),
+                (512, 512, 512), (512, 512, 512))

@@ -1,0 +1,46 @@
+"""DCGAN-style generator/discriminator pair for federated GAN training.
+
+(reference: model/model_hub.py:74-77 serves a GAN for mnist from
+model/generative_adversarial_network/; the federated training loop lives in
+simulation/mpi/fedgan/. The architecture here is a compact DCGAN sized by
+`img_size`/`channels`, GroupNorm everywhere for FL-averaging sanity.)
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z [B, latent] -> image [B, H, W, C] in (-1, 1)."""
+    img_size: int = 28
+    channels: int = 1
+    latent: int = 64
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        s = self.img_size // 4
+        x = nn.Dense(s * s * self.width * 2)(z)
+        x = x.reshape((-1, s, s, self.width * 2))
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = nn.ConvTranspose(self.width, (4, 4), (2, 2))(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = nn.ConvTranspose(self.channels, (4, 4), (2, 2))(x)
+        # crop to the exact size when img_size % 4 != 0
+        x = x[:, : self.img_size, : self.img_size, :]
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image [B, H, W, C] -> real/fake logit [B]."""
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.width, (4, 4), (2, 2))(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(self.width * 2, (4, 4), (2, 2))(x)
+        x = nn.leaky_relu(nn.GroupNorm(num_groups=8)(x), 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1)(x)[:, 0]

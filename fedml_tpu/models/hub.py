@@ -143,6 +143,42 @@ def _transformer_lm(num_classes, **kw):
 MODELS.register("transformer_lm")(_transformer_lm)
 
 
+def _cv(name):
+    def build(num_classes, **kw):
+        from . import cv
+
+        if name == "mobilenet":
+            return cv.MobileNetV1(num_classes, **kw)
+        if name == "mobilenet_v3":
+            return cv.MobileNetV3Small(num_classes, **kw)
+        if name == "efficientnet":
+            return cv.EfficientNetLite(num_classes, **kw)
+        if name == "vgg11":
+            return cv.VGG(num_classes, **kw)
+        if name == "vgg16":
+            return cv.VGG(num_classes, stages=cv.VGG16_STAGES, **kw)
+        raise KeyError(name)
+
+    return build
+
+
+# reference: model_hub.py:60-67 mobilenet / mobilenet_v3 / efficientnet,
+# model/cv/vgg.py — GroupNorm variants (BN stats don't federate)
+for _name in ("mobilenet", "mobilenet_v3", "efficientnet", "vgg11", "vgg16"):
+    MODELS.register(_name)(_cv(_name))
+
+
+def _gan_pair(num_classes, **kw):
+    from .gan import Discriminator, Generator
+
+    return {"generator": Generator(**kw), "discriminator": Discriminator()}
+
+
+# reference: model_hub.py:74-77 ("GAN" for mnist); returns the (G, D) pair
+# consumed by algorithms/fedgan.py
+MODELS.register("gan")(_gan_pair)
+
+
 def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
     """fedml.model.create equivalent (reference: model/model_hub.py:19)."""
     return MODELS.get(model_name)(num_classes=num_classes, **kwargs)

@@ -91,10 +91,15 @@ class AsyncSimulator:
         from ..models.hub import mixed_precision_apply
         apply_fn = mixed_precision_apply(self.model.apply, t.compute_dtype)
 
+        from ..core.algorithm import make_objective
+
+        objective = make_objective(t.extra.get("task"))
+
         def train_one(params, cid, rng_):
             shard = jax.tree.map(lambda a: a[cid], self.data)
             idx = make_batch_indices(rng_, shard_size, t.batch_size, t.epochs)
-            new_params, metrics, _ = local_sgd(apply_fn, params, shard, idx, opt)
+            new_params, metrics, _ = local_sgd(
+                apply_fn, params, shard, idx, opt, objective=objective)
             return new_params, metrics
 
         def merge(global_p, client_p, alpha_eff):

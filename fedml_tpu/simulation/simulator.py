@@ -185,7 +185,10 @@ class Simulator:
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
         )
         self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
-        self._eval = jax.jit(eval_step_fn(self.apply_fn))
+        from ..core.algorithm import make_objective
+
+        self._eval = jax.jit(eval_step_fn(
+            self.apply_fn, make_objective(t.extra.get("task"))))
         self.history: list[dict] = []
 
     # reference parity: np seeded by round index (fedavg_api.py:127-135)
