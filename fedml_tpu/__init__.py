@@ -28,8 +28,18 @@ __all__ = [
     "load_config",
     "init",
     "run_simulation",
+    "FedMLRunner",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # lazy: runner pulls in the runtime modules, which import jax
+    if name == "FedMLRunner":
+        from .runner import FedMLRunner
+
+        return FedMLRunner
+    raise AttributeError(name)
 
 
 def init(config_path: str | None = None, config: Config | dict | None = None,

@@ -22,6 +22,7 @@ TRAINING_TYPE_SIMULATION = "simulation"
 TRAINING_TYPE_CROSS_SILO = "cross_silo"
 TRAINING_TYPE_CROSS_DEVICE = "cross_device"
 TRAINING_TYPE_CROSS_CLOUD = "cross_cloud"
+TRAINING_TYPE_CENTRALIZED = "centralized"  # non-federated baseline runner
 
 # Simulation backends. The reference offers sp/MPI/NCCL; the TPU-native
 # backend is "xla": the whole round is one XLA program over a device mesh.
@@ -221,6 +222,7 @@ class Config:
             TRAINING_TYPE_CROSS_SILO,
             TRAINING_TYPE_CROSS_DEVICE,
             TRAINING_TYPE_CROSS_CLOUD,
+            TRAINING_TYPE_CENTRALIZED,
         ):
             raise ValueError(f"unknown training_type {self.common_args.training_type!r}")
 

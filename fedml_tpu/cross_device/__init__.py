@@ -137,9 +137,13 @@ class CrossDeviceServer:
                 log.warning("device %s: model upload without payload "
                             "rejected", msg.sender_id)
                 return
+            def _check(a, b):
+                if np.shape(a) != np.shape(b):
+                    raise ValueError(
+                        f"leaf shape {np.shape(b)} != {np.shape(a)}")
+
             try:
-                jax.tree.map(lambda a, b: np.broadcast_shapes(
-                    np.shape(a), np.shape(b)), self.params, params)
+                jax.tree.map(_check, self.params, params)
             except Exception:
                 log.warning("device %s: structurally wrong model rejected",
                             msg.sender_id)

@@ -20,7 +20,10 @@ class Generator(nn.Module):
 
     @nn.compact
     def __call__(self, z, train: bool = False):
-        s = self.img_size // 4
+        # ceil so two stride-2 upsamples land AT OR ABOVE img_size — the
+        # crop below then trims the excess (floor would undershoot and the
+        # discriminator's Dense layer would see mismatched flatten widths)
+        s = -(-self.img_size // 4)
         x = nn.Dense(s * s * self.width * 2)(z)
         x = x.reshape((-1, s, s, self.width * 2))
         x = nn.relu(nn.GroupNorm(num_groups=8)(x))

@@ -171,7 +171,10 @@ for _name in ("mobilenet", "mobilenet_v3", "efficientnet", "vgg11", "vgg16"):
 def _gan_pair(num_classes, **kw):
     from .gan import Discriminator, Generator
 
-    return {"generator": Generator(**kw), "discriminator": Discriminator()}
+    # `width` sizes BOTH networks; the remaining knobs are generator-only
+    width = kw.pop("width", 64)
+    return {"generator": Generator(width=width, **kw),
+            "discriminator": Discriminator(width=width)}
 
 
 # reference: model_hub.py:74-77 ("GAN" for mnist); returns the (G, D) pair

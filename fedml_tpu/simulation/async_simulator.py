@@ -94,6 +94,7 @@ class AsyncSimulator:
         from ..core.algorithm import make_objective
 
         objective = make_objective(t.extra.get("task"))
+        self._objective = objective
 
         def train_one(params, cid, rng_):
             shard = jax.tree.map(lambda a: a[cid], self.data)
@@ -109,7 +110,7 @@ class AsyncSimulator:
 
         self._train_one = jax.jit(train_one)
         self._merge = jax.jit(merge)
-        self._eval = jax.jit(eval_step_fn(apply_fn))
+        self._eval = jax.jit(eval_step_fn(apply_fn, objective))
         xb, yb, mb = _pad_test_batches(
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64))
         self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))

@@ -1,0 +1,127 @@
+"""FedMLRunner dispatch + CLI (reference: python/fedml/runner.py:19,
+cli/cli.py)."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.runner import FedMLRunner
+
+
+def _cfg(**common):
+    c = {
+        "common_args": {"training_type": "simulation", **common},
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 16}},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 4, "client_num_per_round": 4,
+                       "comm_round": 2, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.1},
+        "validation_args": {"frequency_of_the_test": 0},
+    }
+    return fedml_tpu.init(config=c)
+
+
+def test_runner_simulation_dispatch():
+    runner = FedMLRunner(_cfg())
+    from fedml_tpu.simulation.simulator import Simulator
+
+    assert isinstance(runner.runner, Simulator)
+    hist = runner.run()
+    assert len(hist) == 2
+
+
+def test_runner_async_dispatch():
+    cfg = _cfg()
+    cfg.train_args.extra["async"] = True
+    from fedml_tpu.simulation.async_simulator import AsyncSimulator
+
+    assert isinstance(FedMLRunner(cfg).runner, AsyncSimulator)
+
+
+def test_runner_centralized_dispatch():
+    cfg = _cfg(training_type="centralized")
+    from fedml_tpu.centralized import CentralizedTrainer
+
+    assert isinstance(FedMLRunner(cfg).runner, CentralizedTrainer)
+
+
+def test_runner_fa_dispatch():
+    cfg = _cfg()
+    cfg.train_args.extra["fa_task"] = "avg"
+    data = [np.arange(10.0), np.arange(10.0) + 1]
+    runner = FedMLRunner(cfg, dataset=data)
+    out = runner.run()
+    np.testing.assert_allclose(out, np.concatenate(
+        [np.arange(10.0), np.arange(10.0) + 1]).mean())
+
+
+def test_runner_cross_silo_roles():
+    from fedml_tpu.cross_silo import FedClientManager, FedServerManager
+    from fedml_tpu.models import hub
+
+    cfg = _cfg(training_type="cross_silo")
+    cfg.train_args.client_num_in_total = 2
+    model = hub.create("lr", 3)
+    srv = FedMLRunner(cfg, model=model, role="server", rank=0,
+                      input_shape=(8,))
+    assert isinstance(srv.runner, FedServerManager)
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 8).astype(np.float32)
+    y = rs.randint(0, 3, 32).astype(np.int32)
+    cli = FedMLRunner(cfg, dataset=(x, y), model=model, role="client",
+                      rank=1)
+    assert isinstance(cli.runner, FedClientManager)
+
+
+def test_runner_unknown_type_raises():
+    cfg = _cfg()
+    cfg.common_args.training_type = "weird"
+    with pytest.raises(ValueError, match="no runner"):
+        FedMLRunner(cfg)
+
+
+def test_cli_version_and_env():
+    out = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu", "version"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0 and "fedml_tpu" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu", "env"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0
+    info = json.loads(out.stdout)
+    assert "jax" in info and "devices" in info
+
+
+def test_cli_run_simulation(tmp_path):
+    cfg_yaml = tmp_path / "cfg.yaml"
+    cfg_yaml.write_text("""
+common_args:
+  training_type: simulation
+  random_seed: 0
+data_args:
+  dataset: synthetic
+model_args:
+  model: lr
+train_args:
+  federated_optimizer: FedAvg
+  client_num_in_total: 2
+  client_num_per_round: 2
+  comm_round: 2
+  epochs: 1
+  batch_size: 8
+  learning_rate: 0.1
+validation_args:
+  frequency_of_the_test: 0
+""")
+    out = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu", "run", "--cf", str(cfg_yaml)],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["round"] == 1
