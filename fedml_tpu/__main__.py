@@ -94,9 +94,6 @@ def main(argv=None) -> int:
     runp = sub.add_parser("run", help="run a fedml_config.yaml")
     runp.add_argument("--cf", "--config", dest="config", required=True,
                       help="path to config yaml (reference-format accepted)")
-    runp.add_argument("--role", default="server",
-                      help="cross-silo/device role: server|client")
-    runp.add_argument("--rank", type=int, default=0)
     runp.add_argument("--rounds", type=int, default=None,
                       help="override comm_round")
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")

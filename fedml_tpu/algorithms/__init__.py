@@ -1,3 +1,3 @@
-from . import fedgan  # noqa: F401  (registers FedGAN in ALGORITHMS)
 from .builtin import build_algorithm  # noqa: F401
+# importing fedgan registers "FedGAN" in ALGORITHMS as a side effect
 from .fedgan import init_gan_params, make_fedgan  # noqa: F401

@@ -1,0 +1,107 @@
+"""MLOps facade — the reference's observability API surface, local-first.
+
+(reference: python/fedml/core/mlops/__init__.py — `mlops.init(args)` :91,
+`mlops.event(name, event_started, ...)` :153, `mlops.log(metrics)` :170,
+`mlops.log_round_info(...)` :763, plus runtime-log redirection
+(mlops_runtime_log.py) and the sys-perf reporters. The reference ships all
+of it to the FedML cloud over MQTT+S3; here the same call names feed the
+process-wide recorder, its sinks (JSONL/wandb — utils/sinks.py), a per-run
+log file, and the sys-perf daemon.)
+
+Usage parity with reference scripts:
+
+    import fedml_tpu
+    from fedml_tpu import mlops
+    cfg = fedml_tpu.init(...)
+    mlops.init(cfg)                      # sinks + log file + sysperf
+    with mlops.event("train"):           # or event(..., started/ended)
+        ...
+    mlops.log({"acc": 0.9})
+    mlops.log_round_info(rounds, r)
+    mlops.finish()
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from .utils.events import recorder
+from .utils.sysperf import SysPerfMonitor
+
+_state: dict = {"sysperf": None, "log_handler": None, "events": {}}
+
+
+def init(cfg, sysperf_interval: Optional[float] = None) -> None:
+    """Attach sinks, redirect runtime logs to a per-run file (reference:
+    mlops_runtime_log.init_logs), and start the sys-perf daemon when
+    tracking is enabled."""
+    from .utils.sinks import attach_from_config
+
+    attach_from_config(cfg)
+    t = cfg.tracking_args
+    if t.enable_tracking and _state["log_handler"] is None:
+        os.makedirs(t.log_file_dir, exist_ok=True)
+        h = logging.FileHandler(
+            os.path.join(t.log_file_dir, f"{t.run_name}.log"))
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        root = logging.getLogger()
+        root.addHandler(h)
+        # records must actually reach the file: lower (never raise) the root
+        # level to INFO (reference: mlops_runtime_log sets its own level)
+        if root.level > logging.INFO:
+            root.setLevel(logging.INFO)
+        _state["log_handler"] = h
+    if t.enable_tracking and _state["sysperf"] is None:
+        interval = sysperf_interval if sysperf_interval is not None else \
+            float(t.extra.get("sysperf_interval", 10.0))
+        _state["sysperf"] = SysPerfMonitor(interval).start()
+
+
+def event(name: str, event_started: Optional[bool] = None,
+          event_value: Optional[str] = None, **meta):
+    """Span event. Two call styles, both from the reference:
+    - context manager: `with mlops.event("train"): ...`
+    - paired calls:    `mlops.event("train", event_started=True)` then
+                       `mlops.event("train", event_started=False)`
+    (reference: mlops_profiler_event.py:74-121)."""
+    if event_started is None:
+        return recorder.span(name, **({"value": event_value} if event_value
+                                      else {}), **meta)
+    key = (name, event_value)
+    if event_started:
+        _state["events"][key] = time.perf_counter()
+    else:
+        t0 = _state["events"].pop(key, None)
+        dur = (time.perf_counter() - t0) if t0 is not None else 0.0
+        recorder.log({"event": name, "value": event_value, "duration": dur})
+    return None
+
+
+def log(metrics: dict) -> None:
+    """reference: mlops.log(:170) — round/step metric row."""
+    recorder.log(dict(metrics))
+
+
+def log_round_info(total_rounds: int, round_index: int) -> None:
+    """reference: mlops.log_round_info(:763)."""
+    recorder.log({"round_index": round_index, "total_rounds": total_rounds})
+
+
+def system_stats() -> dict:
+    from .utils.sysperf import sample_sysperf
+
+    return sample_sysperf()
+
+
+def finish() -> None:
+    """Stop daemons, flush and detach (reference: mlops release paths)."""
+    if _state["sysperf"] is not None:
+        _state["sysperf"].stop()
+        _state["sysperf"] = None
+    if _state["log_handler"] is not None:
+        logging.getLogger().removeHandler(_state["log_handler"])
+        _state["log_handler"].close()
+        _state["log_handler"] = None

@@ -12,8 +12,12 @@ Modes here:
                                  Simulator when the mesh isn't 2-D
 - simulation + async:            AsyncSimulator (train_args.extra.async)
 - cross_silo, role=server:       FedServerManager (+SecAgg variant)
-- cross_silo, role=client:       FedClientManager + SiloTrainer
-- cross_silo + hierarchical:     run_hierarchical (single-host composition)
+- cross_silo, role=client:       FedClientManager + SiloTrainer; with
+                                 scenario=hierarchical the client's
+                                 SiloTrainer gets an intra-silo device mesh
+                                 (single-host all-in-one composition is
+                                 cross_silo.run_hierarchical, called
+                                 directly rather than through this runner)
 - cross_device, role=server:     CrossDeviceServer
 - fa (train_args.extra.fa_task): FASimulator
 - centralized baseline:          CentralizedTrainer (training_type
@@ -66,6 +70,10 @@ class FedMLRunner:
     def _init_simulation(self, dataset, model, **kw):
         t = self.cfg.train_args
         if t.extra.get("async") or t.extra.get("async_mode"):
+            if kw:
+                raise ValueError(
+                    f"async simulation does not accept {sorted(kw)} (the "
+                    "event loop is host-driven, single-device)")
             from .simulation.async_simulator import AsyncSimulator
 
             return AsyncSimulator(self.cfg, dataset, model)
@@ -165,7 +173,7 @@ class FedMLRunner:
             backend, rank,
             run_id=cfg.comm_args.extra.get("run_id", "cd"),
             **({} if backend == "loopback" else
-               {"ip_table": cfg.comm_args.grpc_ipconfig_path}))
+               {"ip_table": cfg.comm_args.grpc_ipconfig_path or None}))
         comm = FedCommManager(tr, rank)
         if role == "server":
             if model is None or "input_shape" not in kw:
@@ -186,6 +194,9 @@ class FedMLRunner:
         from .cross_device import EdgeClient
         from .cross_silo import SiloTrainer
 
+        if dataset is None or model is None:
+            raise ValueError("cross-device client needs `dataset`=(x, y) "
+                             "and `model`")
         x, y = dataset
         trainer = SiloTrainer(model.apply, t, x, y, seed=rank)
         return EdgeClient(comm, rank, trainer,

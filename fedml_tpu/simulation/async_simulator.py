@@ -94,7 +94,6 @@ class AsyncSimulator:
         from ..core.algorithm import make_objective
 
         objective = make_objective(t.extra.get("task"))
-        self._objective = objective
 
         def train_one(params, cid, rng_):
             shard = jax.tree.map(lambda a: a[cid], self.data)

@@ -1,0 +1,78 @@
+"""System-performance monitor — host + device telemetry daemon.
+
+(reference: core/mlops/mlops_device_perfs.py + mlops_job_perfs.py — loops
+sampling cpu/mem/gpu utilization and shipping rows to the MLOps cloud over
+MQTT; system_stats.py wraps psutil.)
+
+Local-first equivalent: a daemon thread samples psutil (cpu%, rss, host
+mem) and JAX device memory stats (TPU HBM bytes_in_use when the backend
+exposes memory_stats) and emits "sysperf" rows through the process-wide
+recorder, so they land in whatever sinks are attached (JSONL file, wandb).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .events import recorder
+
+
+def sample_sysperf() -> dict:
+    """One sample of host + device stats."""
+    import psutil
+
+    p = psutil.Process()
+    row = {
+        "cpu_pct": psutil.cpu_percent(interval=None),
+        "rss_mb": p.memory_info().rss / 1e6,
+        "host_mem_pct": psutil.virtual_memory().percent,
+        "threads": p.num_threads(),
+    }
+    try:
+        import jax
+
+        for i, d in enumerate(jax.local_devices()):
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if stats:
+                row[f"dev{i}_bytes_in_use"] = int(
+                    stats.get("bytes_in_use", 0))
+                if "bytes_limit" in stats:
+                    row[f"dev{i}_bytes_limit"] = int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return row
+
+
+class SysPerfMonitor:
+    """Background sampler (reference: MLOpsDevicePerfStats.report_*_realtime
+    loops). Emits recorder.log({"sysperf": ...}) every `interval` seconds
+    between start() and stop()."""
+
+    def __init__(self, interval: float = 10.0):
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SysPerfMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    recorder.log({"sysperf": sample_sysperf()})
+                except Exception:  # sampling must never kill the host loop
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="fedml-sysperf")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -1,0 +1,72 @@
+"""MLOps facade + sys-perf monitor (reference: core/mlops/__init__.py
+event/log API, mlops_device_perfs.py sampling loops)."""
+import json
+import time
+
+import fedml_tpu
+from fedml_tpu import mlops
+from fedml_tpu.utils.events import recorder
+from fedml_tpu.utils.sysperf import SysPerfMonitor, sample_sysperf
+
+
+def test_sample_sysperf_fields():
+    row = sample_sysperf()
+    assert row["rss_mb"] > 0
+    assert 0 <= row["host_mem_pct"] <= 100
+    assert row["threads"] >= 1
+
+
+def test_sysperf_monitor_emits_rows():
+    n0 = len(recorder.metrics)
+    mon = SysPerfMonitor(interval=0.1).start()
+    time.sleep(0.45)
+    mon.stop()
+    rows = [m for m in recorder.metrics[n0:] if "sysperf" in m]
+    assert len(rows) >= 2
+    assert rows[0]["sysperf"]["rss_mb"] > 0
+
+
+def test_mlops_facade_end_to_end(tmp_path):
+    cfg = fedml_tpu.init(config={
+        "tracking_args": {"enable_tracking": True,
+                          "log_file_dir": str(tmp_path),
+                          "run_name": "mlops-test",
+                          "extra": {"sysperf_interval": 0.2}},
+    })
+    n_sinks = len(recorder.sinks)
+    n0 = len(recorder.metrics)
+    mlops.init(cfg)
+    try:
+        with mlops.event("train", round=1):
+            time.sleep(0.01)
+        mlops.event("comm", event_started=True)
+        time.sleep(0.01)
+        mlops.event("comm", event_started=False)
+        mlops.log({"acc": 0.5})
+        mlops.log_round_info(10, 3)
+        import logging
+
+        logging.getLogger("fedml_tpu.test").info("hello log daemon")
+        time.sleep(0.3)   # let sysperf tick
+    finally:
+        mlops.finish()
+        del recorder.sinks[n_sinks:]
+
+    rows = recorder.metrics[n0:]
+    assert any(r.get("acc") == 0.5 for r in rows)
+    assert any(r.get("round_index") == 3 for r in rows)
+    assert any(r.get("event") == "comm" and r["duration"] > 0 for r in rows)
+    assert any("sysperf" in r for r in rows)
+    # runtime log file captured the logging output
+    logtxt = (tmp_path / "mlops-test.log").read_text()
+    assert "hello log daemon" in logtxt
+    # events jsonl sink got the rows too
+    events = (tmp_path / "mlops-test.events.jsonl").read_text().splitlines()
+    kinds = {json.loads(l)["kind"] for l in events}
+    assert {"span", "metrics"} <= kinds
+    # idempotent init/finish
+    mlops.finish()
+
+
+def test_system_stats_facade():
+    assert mlops.system_stats()["rss_mb"] > 0
