@@ -68,19 +68,46 @@ def _decode_obj(obj: Any, buffers: list[memoryview]):
     return obj
 
 
+_CRC_TAG = b"C32C"
+
+
 def encode(tree: Pytree) -> bytes:
-    """pytree (dict/list/scalars/ndarray/jax arrays) -> framed bytes."""
+    """pytree (dict/list/scalars/ndarray/jax arrays) -> framed bytes.
+    When the native tier is available, a CRC-32C trailer is appended
+    (native/fedml_native.cpp crc32c) so transport corruption surfaces as a
+    clean ValueError instead of silently-wrong tensors. Receivers without
+    the native lib skip verification; FT01 frames without a trailer decode
+    unchanged."""
     buffers: list[bytes] = []
     header = _encode_obj(tree, buffers)
     sizes = [len(b) for b in buffers]
     head = json.dumps({"tree": header, "sizes": sizes}).encode()
-    return b"".join([_MAGIC, struct.pack("<I", len(head)), head] + buffers)
+    frame = b"".join([_MAGIC, struct.pack("<I", len(head)), head] + buffers)
+    from ..native import crc32c
+
+    crc = crc32c(frame)
+    if crc is not None:
+        frame += _CRC_TAG + struct.pack("<I", crc)
+    return frame
 
 
 def decode(data: bytes | memoryview) -> Pytree:
     data = memoryview(data)
     if bytes(data[:4]) != _MAGIC:
         raise ValueError("bad frame magic (not a fedml_tpu wire frame)")
+    # integrity trailer FIRST: corruption anywhere (including the JSON
+    # header) must surface as a CRC error, not a parse error
+    if len(data) >= 12 and bytes(data[-8:-4]) == _CRC_TAG:
+        from ..native import crc32c
+
+        (want,) = struct.unpack("<I", data[-4:])
+        got = crc32c(bytes(data[:-8]))
+        if got is not None:
+            if got != want:
+                raise ValueError(
+                    f"wire frame CRC mismatch (got {got:#x}, want "
+                    f"{want:#x}) — payload corrupted in transit")
+            data = data[:-8]
     (hlen,) = struct.unpack("<I", data[4:8])
     head = json.loads(bytes(data[8 : 8 + hlen]))
     buffers: list[memoryview] = []

@@ -30,7 +30,8 @@ from typing import Optional
 from .utils.events import recorder
 from .utils.sysperf import SysPerfMonitor
 
-_state: dict = {"sysperf": None, "log_handler": None, "events": {}}
+_state: dict = {"sysperf": None, "log_handler": None, "events": {},
+                "sinks": [], "prev_root_level": None}
 
 
 def init(cfg, sysperf_interval: Optional[float] = None) -> None:
@@ -39,7 +40,7 @@ def init(cfg, sysperf_interval: Optional[float] = None) -> None:
     tracking is enabled."""
     from .utils.sinks import attach_from_config
 
-    attach_from_config(cfg)
+    _state["sinks"].extend(attach_from_config(cfg))
     t = cfg.tracking_args
     if t.enable_tracking and _state["log_handler"] is None:
         os.makedirs(t.log_file_dir, exist_ok=True)
@@ -50,8 +51,9 @@ def init(cfg, sysperf_interval: Optional[float] = None) -> None:
         root = logging.getLogger()
         root.addHandler(h)
         # records must actually reach the file: lower (never raise) the root
-        # level to INFO (reference: mlops_runtime_log sets its own level)
+        # level to INFO; finish() restores it
         if root.level > logging.INFO:
+            _state["prev_root_level"] = root.level
             root.setLevel(logging.INFO)
         _state["log_handler"] = h
     if t.enable_tracking and _state["sysperf"] is None:
@@ -97,11 +99,21 @@ def system_stats() -> dict:
 
 
 def finish() -> None:
-    """Stop daemons, flush and detach (reference: mlops release paths)."""
+    """Stop daemons, detach this run's sinks and log handler, restore the
+    root log level (reference: mlops release paths)."""
     if _state["sysperf"] is not None:
         _state["sysperf"].stop()
         _state["sysperf"] = None
+    for sink in _state["sinks"]:
+        if sink in recorder.sinks:
+            recorder.sinks.remove(sink)
+        getattr(sink, "close", lambda: None)()
+    _state["sinks"].clear()
+    root = logging.getLogger()
     if _state["log_handler"] is not None:
-        logging.getLogger().removeHandler(_state["log_handler"])
+        root.removeHandler(_state["log_handler"])
         _state["log_handler"].close()
         _state["log_handler"] = None
+    if _state["prev_root_level"] is not None:
+        root.setLevel(_state["prev_root_level"])
+        _state["prev_root_level"] = None

@@ -19,9 +19,16 @@ DEFAULT_PRIME = 2**31 - 1  # Mersenne prime: a*b fits in int64 before reduction
 
 def modular_inv(a: np.ndarray | int, p: int = DEFAULT_PRIME):
     """Fermat inverse a^(p-2) mod p (reference: secagg.py:8-22 uses an
-    iterative EEA per scalar; pow-mod vectorizes)."""
+    iterative EEA per scalar). Arrays take the native C++ batch kernel when
+    available (native/fedml_native.cpp ff_modinv_batch — 128-bit mulmod, no
+    per-element python pow); python-int pow is the fallback."""
     if isinstance(a, (int, np.integer)):
         return pow(int(a), p - 2, p)
+    from ..native import modinv_batch
+
+    out = modinv_batch(a, p)
+    if out is not None:
+        return out
     return np.array([pow(int(x), p - 2, p) for x in np.asarray(a).ravel()],
                     dtype=np.int64).reshape(np.shape(a))
 
@@ -75,21 +82,28 @@ def shamir_share(secret: np.ndarray, n: int, t: int, rng: np.random.Generator,
 def shamir_reconstruct(shares: np.ndarray, idxs: list[int],
                        p: int = DEFAULT_PRIME) -> np.ndarray:
     """Reconstruct the secret from >= t+1 shares via Lagrange at 0
-    (reference: BGW_decoding + gen_BGW_lambda_s, secagg.py:180-212)."""
+    (reference: BGW_decoding + gen_BGW_lambda_s, secagg.py:180-212).
+    The basis coefficients come from the native C++ kernel when available
+    (native/fedml_native.cpp ff_lagrange_at_zero) — reconstruction over many
+    holders is the SecAgg server's per-round hot loop."""
     points = np.asarray([i + 1 for i in idxs], dtype=np.int64)
     k = len(points)
-    lam = np.ones(k, dtype=np.int64)
-    for i in range(k):
-        num, den = 1, 1
-        for j in range(k):
-            if i == j:
-                continue
-            num = (num * (-points[j] % p)) % p
-            den = (den * ((points[i] - points[j]) % p)) % p
-        lam[i] = (num * modular_inv(int(den), p)) % p
+    from ..native import lagrange_at_zero
+
+    lam = lagrange_at_zero(points, p)
+    if lam is None:  # pure-python fallback
+        lam = np.ones(k, dtype=np.int64)
+        for i in range(k):
+            num, den = 1, 1
+            for j in range(k):
+                if i == j:
+                    continue
+                num = (num * (-points[j] % p)) % p
+                den = (den * ((points[i] - points[j]) % p)) % p
+            lam[i] = (num * modular_inv(int(den), p)) % p
     out = np.zeros(shares.shape[1], dtype=np.int64)
     for i in range(k):
-        out = (out + lam[i] * shares[i]) % p
+        out = (out + int(lam[i]) * shares[i]) % p
     return out
 
 

@@ -1,0 +1,152 @@
+// fedml_tpu native runtime kernels (C++17, no external deps).
+//
+// The reference keeps performance-critical client/runtime code native: the
+// MobileNN C++ edge engine (reference: android/fedmlsdk/MobileNN/src/
+// FedMLClientManager.cpp, main_MNN_train.cpp — a full on-device trainer)
+// and its C++ LightSecAgg (MobileNN/src/security). TPU-native equivalents:
+//
+//  * ff_modinv_batch / ff_lagrange_at_zero — finite-field kernels for the
+//    SecAgg host path (mpc/finite.py). Python's per-element pow() loop was
+//    the round-1 advisor's hot-spot finding; here Fermat exponentiation
+//    runs in native 128-bit arithmetic over whole share matrices.
+//  * lr_sgd_train — the MobileNN-analog edge trainer: a complete local-SGD
+//    loop (softmax CE, minibatch, in-place params) for logistic-regression
+//    clients that run on hosts WITHOUT jax (the cross_device "phone" role).
+//  * crc32c — frame integrity for the wire codec.
+//
+// Built by fedml_tpu/native/__init__.py with g++ -O3 -shared -fPIC; every
+// entry point has a pure-python fallback, so the .so is an accelerator,
+// never a hard dependency.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------- finite field
+
+// (a * b) mod p without overflow: operands < 2^62, use unsigned __int128.
+static inline uint64_t mulmod(uint64_t a, uint64_t b, uint64_t p) {
+    return (uint64_t)(((unsigned __int128)a * b) % p);
+}
+
+static inline uint64_t powmod(uint64_t base, uint64_t exp, uint64_t p) {
+    uint64_t r = 1 % p;
+    base %= p;
+    while (exp) {
+        if (exp & 1) r = mulmod(r, base, p);
+        base = mulmod(base, base, p);
+        exp >>= 1;
+    }
+    return r;
+}
+
+// out[i] = x[i]^(p-2) mod p  (Fermat inverse; p prime)
+void ff_modinv_batch(const int64_t* x, int64_t* out, int64_t n, int64_t p) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t v = x[i] % p;
+        if (v < 0) v += p;
+        out[i] = (int64_t)powmod((uint64_t)v, (uint64_t)(p - 2), (uint64_t)p);
+    }
+}
+
+// Lagrange basis at zero for points[k]: lam[i] = prod_{j!=i} (-x_j)/(x_i-x_j)
+// mod p — the Shamir reconstruction coefficients (reference:
+// core/mpc/secagg.py gen_BGW_lambda_s).
+void ff_lagrange_at_zero(const int64_t* points, int64_t* lam, int64_t k,
+                         int64_t p) {
+    for (int64_t i = 0; i < k; ++i) {
+        uint64_t num = 1, den = 1;
+        for (int64_t j = 0; j < k; ++j) {
+            if (i == j) continue;
+            int64_t nj = (-points[j]) % p; if (nj < 0) nj += p;
+            int64_t dj = (points[i] - points[j]) % p; if (dj < 0) dj += p;
+            num = mulmod(num, (uint64_t)nj, (uint64_t)p);
+            den = mulmod(den, (uint64_t)dj, (uint64_t)p);
+        }
+        uint64_t inv = powmod(den, (uint64_t)(p - 2), (uint64_t)p);
+        lam[i] = (int64_t)mulmod(num, inv, (uint64_t)p);
+    }
+}
+
+// ------------------------------------------------------------------- crc32c
+// Castagnoli CRC-32 (table-driven), for wire-frame integrity.
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t crc32c(const uint8_t* data, int64_t n) {
+    if (!crc_init_done) crc_init();
+    uint32_t c = 0xFFFFFFFFu;
+    for (int64_t i = 0; i < n; ++i)
+        c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------- native edge trainer (LR)
+// MobileNN-analog: full local-SGD loop for a softmax linear model, for
+// edge hosts without jax. Layout: W [d, k] row-major then b [k].
+// x [n, d] float32, y [n] int32. Minibatches are taken in the caller-
+// provided order (perm [steps*bs]), so python controls shuffling/seeding.
+// Returns mean loss over all steps.
+double lr_sgd_train(const float* x, const int32_t* y, int64_t n, int64_t d,
+                    int64_t k, float* params, const int64_t* perm,
+                    int64_t steps, int64_t bs, double lr) {
+    float* W = params;          // [d, k]
+    float* b = params + d * k;  // [k]
+    double total_loss = 0.0;
+    double* logits = new double[k];
+    double* gb = new double[k];
+    double* gW = new double[d * k];
+
+    for (int64_t s = 0; s < steps; ++s) {
+        std::fill(gb, gb + k, 0.0);
+        std::fill(gW, gW + d * k, 0.0);
+        double step_loss = 0.0;
+        for (int64_t bi = 0; bi < bs; ++bi) {
+            int64_t idx = perm[s * bs + bi];
+            const float* xi = x + idx * d;
+            // logits = W^T x + b
+            for (int64_t c = 0; c < k; ++c) logits[c] = b[c];
+            for (int64_t j = 0; j < d; ++j) {
+                double xv = xi[j];
+                const float* wrow = W + j * k;
+                for (int64_t c = 0; c < k; ++c) logits[c] += xv * wrow[c];
+            }
+            // softmax CE (stable)
+            double m = logits[0];
+            for (int64_t c = 1; c < k; ++c) m = std::max(m, logits[c]);
+            double z = 0.0;
+            for (int64_t c = 0; c < k; ++c) z += std::exp(logits[c] - m);
+            int32_t yi = y[idx];
+            step_loss += -(logits[yi] - m - std::log(z));
+            // grad: softmax - onehot
+            for (int64_t c = 0; c < k; ++c) {
+                double pc = std::exp(logits[c] - m) / z - (c == yi ? 1.0 : 0.0);
+                gb[c] += pc;
+                for (int64_t j = 0; j < d; ++j) gW[j * k + c] += pc * xi[j];
+            }
+        }
+        double scale = lr / (double)bs;
+        for (int64_t c = 0; c < k; ++c) b[c] -= (float)(scale * gb[c]);
+        for (int64_t j = 0; j < d * k; ++j) W[j] -= (float)(scale * gW[j]);
+        total_loss += step_loss / (double)bs;
+    }
+    delete[] logits;
+    delete[] gb;
+    delete[] gW;
+    return steps > 0 ? total_loss / (double)steps : 0.0;
+}
+
+}  // extern "C"
