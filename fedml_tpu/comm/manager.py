@@ -91,10 +91,17 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
             "a device mesh, parallel/round.py), not a message transport; use "
             "'grpc' or 'loopback' for the cross-silo message layer"
         )
-    if b in ("mqtt_s3", "mqtt", "trpc", "mpi"):
+    if b in ("broker", "mqtt_s3", "mqtt"):
+        # the cross-org pub/sub plane: store-and-forward topics + blob
+        # side-channel (comm/broker.py; reference MQTT+S3 shape)
+        from .broker import BrokerTransport
+
+        return BrokerTransport(rank, run_id, **kw)
+    if b in ("trpc", "mpi"):
         raise ValueError(
             f"backend {b!r} is a reference transport not provided in the TPU "
-            "build; 'grpc' covers cross-silo DCN messaging and 'loopback' "
-            "covers single-box testing"
+            "build; 'grpc' covers cross-silo DCN messaging, 'broker' covers "
+            "the MQTT+S3 cross-org role, and 'loopback' covers single-box "
+            "testing"
         )
     raise ValueError(f"unknown comm backend {backend!r}")

@@ -101,7 +101,7 @@ def decode(data: bytes | memoryview) -> Pytree:
         from ..native import crc32c
 
         (want,) = struct.unpack("<I", data[-4:])
-        got = crc32c(bytes(data[:-8]))
+        got = crc32c(data[:-8])  # memoryview: zero-copy into the kernel
         if got is not None:
             if got != want:
                 raise ValueError(

@@ -111,7 +111,8 @@ def lagrange_at_zero(points: np.ndarray, p: int) -> Optional[np.ndarray]:
     return lam
 
 
-def crc32c(data: bytes) -> Optional[int]:
+def crc32c(data) -> Optional[int]:
+    """CRC-32C of a bytes-like (bytes/bytearray/memoryview — zero-copy)."""
     lib = _load()
     if lib is None:
         return None

@@ -104,10 +104,14 @@ class FedMLRunner:
         t = cfg.train_args
         backend = transport or cfg.comm_args.extra.get("transport", "loopback")
         ip_table = cfg.comm_args.grpc_ipconfig_path or None
-        tr = create_transport(backend, rank, ip_table=ip_table
-                              ) if backend != "loopback" else \
-            create_transport("loopback", rank,
-                             run_id=cfg.comm_args.extra.get("run_id", "cs"))
+        run_id = cfg.comm_args.extra.get("run_id", "cs")
+        if backend == "grpc":
+            tr = create_transport(backend, rank, ip_table=ip_table)
+        else:
+            # loopback AND broker are namespaced by run_id — the broker is
+            # store-and-forward, so sharing a default namespace would leak
+            # one run's frames into the next
+            tr = create_transport(backend, rank, run_id=run_id)
         comm = FedCommManager(tr, rank)
         secagg = bool(t.extra.get("secagg"))
         client_ids = list(range(1, t.client_num_in_total + 1))

@@ -86,9 +86,14 @@ def test_backend_factory_errors():
     with pytest.raises(ValueError, match="collective"):
         create_transport("xla", 0)
     with pytest.raises(ValueError, match="grpc"):
-        create_transport("mqtt_s3", 0)
+        create_transport("trpc", 0)
     with pytest.raises(ValueError):
         create_transport("bogus", 0)
+    # mqtt_s3 now resolves to the broker transport (comm/broker.py)
+    from fedml_tpu.comm.broker import BrokerTransport
+
+    assert isinstance(create_transport("mqtt_s3", 0, run_id="fct"),
+                      BrokerTransport)
 
 
 def test_grpc_transport_roundtrip():
