@@ -21,7 +21,6 @@ implement, no changes above L0). Key semantics preserved from MQTT+S3:
 """
 from __future__ import annotations
 
-import queue
 import threading
 import uuid
 from collections import defaultdict, deque
@@ -116,7 +115,9 @@ class BrokerTransport(BaseTransport):
         self.broker.publish(self._topic(msg.receiver_id), frame)
 
     def handle_receive_message(self) -> None:
-        self._stop_event.clear()
+        # NOTE: no clear() here — a stop() issued before this thread is
+        # scheduled must win, or the loop would spin forever; a stopped
+        # transport is done (build a new one to reconnect).
         topic = self._topic(self.rank)
         while not self._stop_event.is_set():
             frame = self.broker.poll(topic, timeout=0.2)

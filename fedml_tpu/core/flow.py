@@ -95,16 +95,25 @@ class FedMLAlgorithmFlow:
     # ------------------------------------------------------------- running
     def run(self, initial_params: Optional[dict] = None,
             background: bool = True) -> None:
-        """Start the receive loop; the server kicks off stage 0."""
+        """Start the flow; the owner of stage 0 kicks it off. The kick-off
+        happens BEFORE entering a blocking receive loop (transports queue
+        outbound/inbound frames until the loop drains them), so
+        background=False cannot deadlock."""
         if not self.sequence:
             self.build()
-        self.comm.run(background=background)
-        if self.role == self.sequence[0].role == ROLE_SERVER and \
-                self.rank == self.server_id:
-            self._execute(0, dict(initial_params or {}))
-        elif self.sequence[0].role == ROLE_CLIENT and \
-                self.role == ROLE_CLIENT:
-            self._execute(0, dict(initial_params or {}))
+        starter = (
+            (self.sequence[0].role == ROLE_SERVER
+             and self.role == ROLE_SERVER and self.rank == self.server_id)
+            or (self.sequence[0].role == ROLE_CLIENT
+                and self.role == ROLE_CLIENT))
+        if background:
+            self.comm.run(background=True)
+            if starter:
+                self._execute(0, dict(initial_params or {}))
+        else:
+            if starter:
+                self._execute(0, dict(initial_params or {}))
+            self.comm.run(background=False)
 
     def _execute(self, seq: int, params: dict) -> None:
         stage = self.sequence[seq]

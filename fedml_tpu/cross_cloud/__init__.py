@@ -76,5 +76,12 @@ def run_cross_cloud(
         for c in clients:
             c.done.wait(timeout=30)
     finally:
+        # stop every manager's receive thread on ALL paths — a timed-out
+        # run would otherwise leak N+1 daemon threads polling the broker
+        for mgr in [server] + clients:
+            try:
+                mgr.comm.stop()
+            except Exception:
+                pass
         release_broker(run_id)
     return server

@@ -69,7 +69,9 @@ def pack_client_shards(
 
     n = len(parts)
     xs = np.zeros((n, size) + x.shape[1:], dtype=x.dtype)
-    ys = np.zeros((n, size), dtype=np.int64)
+    # y may be per-sample labels [N] or per-position sequence targets [N, T]
+    # (NWP tasks like shakespeare)
+    ys = np.zeros((n, size) + y.shape[1:], dtype=np.int64)
     mask = np.zeros((n, size), dtype=np.float32)
     for i, p in enumerate(parts):
         if len(p) > size:

@@ -33,6 +33,7 @@ DATASET_SHAPES = {
     "cinic10": ((32, 32, 3), 10),
     "synthetic": ((60,), 10),
     "digits": ((8, 8, 1), 10),
+    "shakespeare": ((80,), 80),   # 80-char contexts, char-vocab classes
 }
 
 
@@ -58,14 +59,17 @@ def synthetic_classification(
 
 def _build_from_arrays(x, y, x_test, y_test, num_classes, cfg: Config) -> FedDataset:
     t, d = cfg.train_args, cfg.data_args
+    # sequence targets ([N, T] token tasks) partition by their last token;
+    # the Dirichlet partitioner needs one class label per sample
+    part_labels = y if np.ndim(y) == 1 else np.asarray(y)[:, -1]
     parts = partition(
-        y, t.client_num_in_total, d.partition_method, d.partition_alpha,
-        seed=cfg.common_args.random_seed,
+        part_labels, t.client_num_in_total, d.partition_method,
+        d.partition_alpha, seed=cfg.common_args.random_seed,
     )
     ds = pack_client_shards(
         x, y, parts, x_test, y_test, num_classes, pad_multiple=t.batch_size
     )
-    ds.client_class_stats = record_data_stats(y, parts)
+    ds.client_class_stats = record_data_stats(part_labels, parts)
     return ds
 
 
@@ -73,6 +77,21 @@ def _synthetic_for(name: str, cfg: Config) -> FedDataset:
     shape, num_classes = DATASET_SHAPES.get(name, DATASET_SHAPES["synthetic"])
     per_client = int(cfg.data_args.extra.get("synthetic_samples_per_client", 120))
     n = max(cfg.train_args.client_num_in_total * per_client, 500)
+    if name == "shakespeare":
+        # token task: sequences where next char = (char + 1) mod V —
+        # learnable by any sequence model; targets per position (NWP shape)
+        rng = np.random.RandomState(cfg.common_args.random_seed)
+        total = int(n * 1.25)
+        starts = rng.randint(0, num_classes, size=(total, 1))
+        x = (starts + np.arange(shape[0])) % num_classes
+        y = (x + 1) % num_classes
+        n_test = int(total * 0.2)
+        ds = _build_from_arrays(
+            x[n_test:].astype(np.int64), y[n_test:].astype(np.int64),
+            x[:n_test].astype(np.int64), y[:n_test].astype(np.int64),
+            num_classes, cfg)
+        ds.synthetic = True
+        return ds
     (x, y), (xt, yt) = synthetic_classification(
         int(n * 1.25), shape, num_classes, seed=cfg.common_args.random_seed
     )
@@ -98,6 +117,17 @@ def _digits(cfg: Config) -> FedDataset:
     return _build_from_arrays(x[n_test:], y[n_test:], x[:n_test], y[:n_test], 10, cfg)
 
 
+def _read_leaf_dir(d: Path):
+    """LEAF json reader shared by every per-client dataset: *.json files
+    with {"users": [...], "user_data": {u: {"x": ..., "y": ...}}}."""
+    users, data = [], {}
+    for f in sorted(d.glob("*.json")):
+        blob = json.loads(f.read_text())
+        users.extend(blob["users"])
+        data.update(blob["user_data"])
+    return users, data
+
+
 def _leaf_json_mnist(cache_dir: Path, cfg: Config) -> FedDataset | None:
     """LEAF per-client json format (reference: data/MNIST/data_loader.py:32-107:
     train/all_data_*.json with users/user_data{x,y}). Natural client partition —
@@ -106,16 +136,8 @@ def _leaf_json_mnist(cache_dir: Path, cfg: Config) -> FedDataset | None:
     if not train_dir.is_dir() or not test_dir.is_dir():
         return None
 
-    def read_dir(d: Path):
-        users, data = [], {}
-        for f in sorted(d.glob("*.json")):
-            blob = json.loads(f.read_text())
-            users.extend(blob["users"])
-            data.update(blob["user_data"])
-        return users, data
-
-    users, train_data = read_dir(train_dir)
-    _, test_data = read_dir(test_dir)
+    users, train_data = _read_leaf_dir(train_dir)
+    _, test_data = _read_leaf_dir(test_dir)
     users = users[: cfg.train_args.client_num_in_total]
     xs, ys, parts, off = [], [], [], 0
     for u in users:
@@ -133,6 +155,140 @@ def _leaf_json_mnist(cache_dir: Path, cfg: Config) -> FedDataset | None:
     yt = np.concatenate([np.asarray(test_data[u]["y"], dtype=np.int64) for u in users])
     ds = pack_client_shards(x, y, parts, xt, yt, 10, pad_multiple=cfg.train_args.batch_size)
     return ds
+
+
+def _cifar_batches(name: str, cache_dir: Path, cfg: Config) -> FedDataset | None:
+    """Standard CIFAR python pickle batches (the format every CIFAR mirror
+    ships: cifar-10-batches-py/data_batch_* + test_batch, or
+    cifar-100-python/{train,test}) — reference: data/cifar10/data_loader.py
+    reads the same archives via torchvision."""
+    import pickle
+
+    if name == "cifar10":
+        d = cache_dir / "cifar-10-batches-py"
+        train_files = [d / f"data_batch_{i}" for i in range(1, 6)]
+        test_files = [d / "test_batch"]
+        label_key = b"labels"
+    else:  # cifar100
+        d = cache_dir / "cifar-100-python"
+        train_files = [d / "train"]
+        test_files = [d / "test"]
+        label_key = b"fine_labels"
+    if not all(f.is_file() for f in train_files + test_files):
+        return None
+
+    def read(files):
+        xs, ys = [], []
+        for f in files:
+            with open(f, "rb") as fh:
+                blob = pickle.load(fh, encoding="bytes")
+            x = np.asarray(blob[b"data"], np.uint8).reshape(-1, 3, 32, 32)
+            xs.append(x.transpose(0, 2, 3, 1))   # NCHW -> NHWC
+            ys.append(np.asarray(blob[label_key], np.int64))
+        return (np.concatenate(xs).astype(np.float32) / 255.0,
+                np.concatenate(ys))
+
+    x, y = read(train_files)
+    xt, yt = read(test_files)
+    return _build_from_arrays(x, y, xt, yt,
+                              10 if name == "cifar10" else 100, cfg)
+
+
+def _leaf_json_generic(dirname: str, shape: tuple, num_classes: int,
+                       cache_dir: Path, cfg: Config) -> FedDataset | None:
+    """LEAF per-client json (femnist and friends): <cache>/<dirname>/
+    {train,test}/*.json with users/user_data{x,y} — the MNIST reader's
+    structure generalized (reference: data/FederatedEMNIST + LEAF)."""
+    train_dir = cache_dir / dirname / "train"
+    test_dir = cache_dir / dirname / "test"
+    if not train_dir.is_dir() or not test_dir.is_dir():
+        return None
+
+    users, train_data = _read_leaf_dir(train_dir)
+    _, test_data = _read_leaf_dir(test_dir)
+    users = [u for u in users if u in test_data][
+        : cfg.train_args.client_num_in_total]
+    if not users:
+        return None
+    xs, ys, parts, off = [], [], [], 0
+    for u in users:
+        ux = np.asarray(train_data[u]["x"], np.float32).reshape(
+            (-1,) + tuple(shape))
+        uy = np.asarray(train_data[u]["y"], np.int64)
+        xs.append(ux)
+        ys.append(uy)
+        parts.append(np.arange(off, off + len(uy)))
+        off += len(uy)
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    xt = np.concatenate([
+        np.asarray(test_data[u]["x"], np.float32).reshape((-1,) + tuple(shape))
+        for u in users])
+    yt = np.concatenate([np.asarray(test_data[u]["y"], np.int64)
+                         for u in users])
+    return pack_client_shards(x, y, parts, xt, yt, num_classes,
+                              pad_multiple=cfg.train_args.batch_size)
+
+
+# the reference's shakespeare char vocabulary (utils/language_utils.py)
+_SHAKES_VOCAB = (
+    "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    "[]abcdefghijklmnopqrstuvwxyz}"
+)
+_SHAKES_CHAR = {c: i for i, c in enumerate(_SHAKES_VOCAB)}
+
+
+def _encode_chars(s: str) -> np.ndarray:
+    return np.asarray([_SHAKES_CHAR.get(c, 1) for c in s], np.int64)
+
+
+def _leaf_shakespeare(cache_dir: Path, cfg: Config) -> FedDataset | None:
+    """LEAF shakespeare: per-user x = 80-char context strings, y = next
+    char (reference: data/fed_shakespeare + utils/language_utils.py). Built
+    as a next-char sequence task: x_train [*, 80] int tokens, y the x
+    shifted by one (the CharRNN/transformer_lm NWP head shape)."""
+    train_dir = cache_dir / "shakespeare" / "train"
+    test_dir = cache_dir / "shakespeare" / "test"
+    if not train_dir.is_dir() or not test_dir.is_dir():
+        return None
+
+    users, train_data = _read_leaf_dir(train_dir)
+    _, test_data = _read_leaf_dir(test_dir)
+    users = [u for u in users if u in test_data][
+        : cfg.train_args.client_num_in_total]
+    if not users:
+        return None
+    L = DATASET_SHAPES["shakespeare"][0][0]   # fixed 80 — users whose
+    # contexts are shorter pad to it (a per-user max would produce ragged
+    # arrays that cannot concatenate across users)
+
+    def seqs(data, u):
+        # LEAF x: 80-char contexts, y: the single next char. The NWP head
+        # ([B, T, V] logits vs y [B, T]) wants per-position targets, so the
+        # target sequence is the context shifted left with the next char
+        # appended (reference fed_shakespeare trains the same shape).
+        xs = [_encode_chars(s) for s in data[u]["x"]]
+        ys = [_encode_chars(c)[0] for c in data[u]["y"]]
+        out = np.zeros((len(xs), L), np.int64)
+        tgt = np.zeros((len(xs), L), np.int64)
+        for i, (s, nxt) in enumerate(zip(xs, ys)):
+            out[i, : min(len(s), L)] = s[:L]
+            shifted = np.concatenate([s[1:], [nxt]])
+            tgt[i, : min(len(shifted), L)] = shifted[:L]
+        return out, tgt
+
+    xs, ys, parts, off = [], [], [], 0
+    for u in users:
+        ux, uy = seqs(train_data, u)
+        xs.append(ux)
+        ys.append(uy)
+        parts.append(np.arange(off, off + len(uy)))
+        off += len(uy)
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    xt_list = [seqs(test_data, u) for u in users]
+    xt = np.concatenate([a for a, _ in xt_list])
+    yt = np.concatenate([b for _, b in xt_list])
+    return pack_client_shards(x, y, parts, xt, yt, len(_SHAKES_VOCAB),
+                              pad_multiple=cfg.train_args.batch_size)
 
 
 def _npz_dataset(name: str, cache_dir: Path, cfg: Config) -> FedDataset | None:
@@ -163,6 +319,18 @@ def _make_named_loader(name: str):
             return _digits(cfg)
         if name == "mnist":
             ds = _leaf_json_mnist(cache, cfg)
+            if ds is not None:
+                return ds
+        if name in ("cifar10", "cifar100"):
+            ds = _cifar_batches(name, cache, cfg)
+            if ds is not None:
+                return ds
+        if name == "femnist":
+            ds = _leaf_json_generic("femnist", (28, 28, 1), 62, cache, cfg)
+            if ds is not None:
+                return ds
+        if name == "shakespeare":
+            ds = _leaf_shakespeare(cache, cfg)
             if ds is not None:
                 return ds
         ds = _npz_dataset(name, cache, cfg)

@@ -113,8 +113,18 @@ class CharRNN(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Embed(self.vocab_size, self.embed_dim)(x)
-        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
-        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden))(x)
+        # Seed each LSTM's initial carry FROM the input: under shard_map
+        # (the xla client-parallel round) nn.RNN's internal zeros carry is
+        # typed replicated while the scanned body produces device-varying
+        # values, which the scan rejects; an input-derived zero inherits
+        # the input's varying axes and types the loop correctly.
+        zero = (x.sum(axis=tuple(range(1, x.ndim))) * 0.0)[:, None]
+        for _ in range(2):
+            cell = nn.OptimizedLSTMCell(self.hidden)
+            carry = cell.initialize_carry(
+                jax.random.key(0), x.shape[:1] + x.shape[-1:])
+            carry = jax.tree.map(lambda c: c + zero, carry)
+            x = nn.RNN(cell)(x, initial_carry=carry)
         return nn.Dense(self.vocab_size)(x)
 
 

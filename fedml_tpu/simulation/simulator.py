@@ -51,7 +51,8 @@ def _pad_test_batches(x: np.ndarray, y: np.ndarray, batch_size: int):
     nb = (n + batch_size - 1) // batch_size
     pad = nb * batch_size - n
     xp = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)]) if pad else x
-    yp = np.concatenate([y, np.zeros((pad,), y.dtype)]) if pad else y
+    # y may carry trailing dims (sequence targets [N, T], multilabel [N, L])
+    yp = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)]) if pad else y
     mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
     rs = lambda a: a.reshape((nb, batch_size) + a.shape[1:])
     return rs(xp), rs(yp), rs(mask)
