@@ -1,0 +1,106 @@
+"""Scheduler agents (reference: computing/scheduler/ — master/slave
+runners + SchedulerMatcher)."""
+import uuid
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import FedCommManager
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.scheduler import (
+    STATUS_FAILED, STATUS_FINISHED, STATUS_UNMATCHABLE, MasterAgent,
+    ResourceMatcher, WorkerAgent,
+)
+
+
+def test_matcher_smallest_sufficient_worker():
+    workers = {1: {"devices": 8, "mem_mb": 4096, "tags": ["tpu"]},
+               2: {"devices": 2, "mem_mb": 2048, "tags": ["cpu"]}}
+    job = {"requirements": {"min_devices": 2}}
+    assert ResourceMatcher.match(job, workers, busy=set()) == 2
+    job_big = {"requirements": {"min_devices": 4}}
+    assert ResourceMatcher.match(job_big, workers, busy=set()) == 1
+    job_tag = {"requirements": {"tags": ["tpu"]}}
+    assert ResourceMatcher.match(job_tag, workers, busy=set()) == 1
+    assert ResourceMatcher.match(job_big, workers, busy={1}) is None
+    assert not ResourceMatcher.matchable(
+        {"requirements": {"min_devices": 99}}, workers)
+
+
+def _launch(n_workers=2, resources=None, **master_kw):
+    run_id = f"sched-{uuid.uuid4().hex[:6]}"
+    master = MasterAgent(FedCommManager(LoopbackTransport(0, run_id), 0),
+                         **master_kw)
+    workers = []
+    for wid in range(1, n_workers + 1):
+        res = (resources or {}).get(wid)
+        w = WorkerAgent(FedCommManager(LoopbackTransport(wid, run_id), wid),
+                        wid, resources=res)
+        workers.append(w)
+    master.run()
+    for w in workers:
+        w.run()
+        w.announce()
+    return run_id, master, workers
+
+
+def test_schedule_simulation_jobs_end_to_end():
+    run_id, master, workers = _launch(2)
+    spec = {"type": "simulation", "config": {
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 16}},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 2, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.3},
+        "validation_args": {"frequency_of_the_test": 0},
+    }}
+    j1 = master.submit(spec)
+    j2 = master.submit(spec)
+    a = master.wait(j1, timeout=300)
+    b = master.wait(j2, timeout=300)
+    assert a.status == STATUS_FINISHED, a.result
+    assert b.status == STATUS_FINISHED, b.result
+    assert np.isfinite(a.result["train_loss"])
+    # two free workers -> the jobs ran on different workers
+    assert {a.worker, b.worker} == {1, 2}
+    master.stop()
+    for w in workers:
+        w.stop()
+    release_router(run_id)
+
+
+def test_python_jobs_and_failure_reporting():
+    run_id, master, workers = _launch(1)
+    for w in workers:
+        w.register_python_job("add", lambda args: args["a"] + args["b"])
+    ok = master.submit({"type": "python", "entry": "add",
+                        "args": {"a": 2, "b": 3}})
+    bad = master.submit({"type": "python", "entry": "nope"})
+    assert master.wait(ok, timeout=60).result == 5
+    j = master.wait(bad, timeout=60)
+    assert j.status == STATUS_FAILED and "nope" in j.result
+    master.stop()
+    for w in workers:
+        w.stop()
+    release_router(run_id)
+
+
+def test_unmatchable_job_is_flagged_after_grace():
+    run_id, master, workers = _launch(
+        1, resources={1: {"devices": 1, "mem_mb": 100, "tags": []}},
+        unmatchable_grace=1.0)
+    import time
+
+    time.sleep(0.2)  # let the worker registration land
+    jid = master.submit({"type": "python", "entry": "x",
+                         "requirements": {"min_devices": 64}})
+    # not condemned instantly: a capable worker may still be registering
+    assert master.status(jid) == "QUEUED"
+    j = master.wait(jid, timeout=60)
+    assert j.status == STATUS_UNMATCHABLE
+    master.stop()
+    for w in workers:
+        w.stop()
+    release_router(run_id)
