@@ -1,0 +1,83 @@
+"""DARTS search space — differentiable NAS cells for FedNAS.
+
+(reference: model_hub.py:67-73 serves a DARTS network for cifar10 from
+model/cv/darts/ (model_search.py mixed ops with architecture parameters);
+simulation/mpi/fednas/ federates BOTH the weights and the architecture
+alphas — FedNAS, He et al. 2020.)
+
+TPU design: architecture parameters are ordinary params in the pytree
+(`alpha` leaves), so the EXISTING engine federates them with the weights —
+FedAvg over the params tree IS FedNAS aggregation. The mixed op computes
+every candidate and softmax-combines: all branches are static-shape convs
+XLA fuses; `discretize` reads the learned alphas back as an architecture.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+OPS = ("conv3", "conv1", "skip", "avgpool")
+
+
+class MixedOp(nn.Module):
+    """Softmax-weighted mixture over the candidate ops (reference:
+    model/cv/darts/model_search.py MixedOp)."""
+    ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param("alpha", nn.initializers.zeros, (len(OPS),))
+        w = jax.nn.softmax(alpha)
+        branches = [
+            nn.relu(nn.GroupNorm(num_groups=8)(
+                nn.Conv(self.ch, (3, 3), use_bias=False)(x))),
+            nn.relu(nn.GroupNorm(num_groups=8)(
+                nn.Conv(self.ch, (1, 1), use_bias=False)(x))),
+            x if x.shape[-1] == self.ch
+            else nn.Conv(self.ch, (1, 1), use_bias=False)(x),
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            if x.shape[-1] == self.ch
+            else nn.Conv(self.ch, (1, 1), use_bias=False)(
+                nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")),
+        ]
+        return sum(w[i] * b for i, b in enumerate(branches))
+
+
+class DartsNet(nn.Module):
+    """Small DARTS supernet: stem -> mixed-op cells (stride-2 pools
+    between) -> head."""
+    num_classes: int
+    channels: Sequence[int] = (16, 32)
+    cells_per_stage: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.GroupNorm(num_groups=8)(
+            nn.Conv(self.channels[0], (3, 3), use_bias=False)(x)))
+        for si, ch in enumerate(self.channels):
+            for _ in range(self.cells_per_stage):
+                x = MixedOp(ch)(x)
+            if si < len(self.channels) - 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def extract_alphas(params) -> dict:
+    """{cell_path: softmax(alpha)} — the current architecture beliefs."""
+    out = {}
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        names = [str(getattr(p, "key", "")) for p in path]
+        if names[-1] == "alpha":
+            out["/".join(names[:-1])] = jax.nn.softmax(leaf)
+    return out
+
+
+def discretize(params) -> dict:
+    """Argmax architecture readout (reference: model_search.py genotype)."""
+    return {cell: OPS[int(jnp.argmax(w))]
+            for cell, w in extract_alphas(params).items()}

@@ -192,6 +192,17 @@ def _gan_pair(num_classes, **kw):
 MODELS.register("gan")(_gan_pair)
 
 
+def _darts(num_classes, **kw):
+    from .darts import DartsNet
+
+    return DartsNet(num_classes, **kw)
+
+
+# reference: model_hub.py:67-73 DARTS search space; federating this model's
+# params (weights + alphas) with FedAvg IS FedNAS (simulation/mpi/fednas/)
+MODELS.register("darts")(_darts)
+
+
 def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
     """fedml.model.create equivalent (reference: model/model_hub.py:19)."""
     return MODELS.get(model_name)(num_classes=num_classes, **kwargs)
