@@ -240,10 +240,11 @@ def bench_torch_baseline(n_clients_sub: int = 4) -> float:
     return 1.0 / round_time_full
 
 
-def bench_fedllm() -> dict:
+def bench_fedllm(quick: bool = False) -> dict:
     """FedLLM slice evidence (BASELINE workload 5): one federated-LoRA round
     on a mid-size transformer, on this chip. Reports decode-free training
-    tokens/sec and the payload reduction adapters buy over full weights."""
+    tokens/sec and the payload reduction adapters buy over full weights.
+    --quick shrinks the model (CPU hosts: the full size is ~3 min/round)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -251,22 +252,22 @@ def bench_fedllm() -> dict:
     from fedml_tpu.config import TrainArgs
     from fedml_tpu.llm import count_params, federated_lora
     from fedml_tpu.llm.transformer import TransformerLM
-    from fedml_tpu.models.hub import mixed_precision_apply
     from fedml_tpu.parallel.round import build_round_fn
 
-    n_clients, s, t_len, vocab = 8, 16, 512, 512
-    model = TransformerLM(vocab_size=vocab, d_model=512, n_layers=6,
-                          n_heads=8, d_ff=2048)
+    if quick:
+        n_clients, s, t_len, vocab = 4, 4, 128, 128
+        model = TransformerLM(vocab_size=vocab, d_model=128, n_layers=2,
+                              n_heads=4, d_ff=512)
+    else:
+        n_clients, s, t_len, vocab = 8, 16, 512, 512
+        model = TransformerLM(vocab_size=vocab, d_model=512, n_layers=6,
+                              n_heads=8, d_ff=2048)
     base = model.init(jax.random.key(0),
                       jnp.zeros((1, t_len), jnp.int32))["params"]
-    t = TrainArgs(epochs=1, batch_size=8, learning_rate=0.1)
-    # bf16 compute comes from this wrap (federated_lora doesn't read
-    # TrainArgs.compute_dtype — that flag drives the Simulator path only)
-    import types
-
-    model_bf16 = types.SimpleNamespace(
-        apply=mixed_precision_apply(model.apply, "bfloat16"))
-    alg, adapters = federated_lora(model_bf16, base, t, jax.random.key(1),
+    # federated_lora honors compute_dtype (same mechanism as the Simulator)
+    t = TrainArgs(epochs=1, batch_size=8, learning_rate=0.1,
+                  compute_dtype="bfloat16")
+    alg, adapters = federated_lora(model, base, t, jax.random.key(1),
                                    rank=8)
     rs = np.random.RandomState(0)
     seqs = rs.randint(0, vocab, (n_clients, s, t_len + 1))
@@ -308,7 +309,9 @@ def main():
     acc = bench_accuracy_real()
     base_rps = bench_torch_baseline(2 if quick else 4)
     try:
-        llm = bench_fedllm()
+        llm = bench_fedllm(quick=quick)
+        if quick:
+            llm["fedllm_quick_size"] = True
     except Exception as e:  # the headline metric must survive an LLM hiccup
         llm = {"fedllm_error": f"{type(e).__name__}: {e}"}
     print(json.dumps({

@@ -63,8 +63,14 @@ def federated_lora(model: TransformerLM, base_params: Pytree, t: TrainArgs,
     NOTE: the round engines donate their input server state; if you need the
     initial adapters after a round has run (e.g. to seed a second runtime),
     copy them first: jax.tree.map(jnp.array, adapters)."""
+    from ..models.hub import mixed_precision_apply
+
     adapters = lora_init(rng, base_params, rank=rank, targets=targets)
-    apply_fn = lora_apply_fn(model.apply, base_params, alpha)
+    # honor TrainArgs.compute_dtype like the Simulator path does
+    # (simulator.py): bf16 runs the merged matmuls on the MXU while the
+    # adapters/optimizer stay f32
+    base_apply = mixed_precision_apply(model.apply, t.compute_dtype)
+    apply_fn = lora_apply_fn(base_apply, base_params, alpha)
     alg = make_fedavg(apply_fn, t)
     return alg, adapters
 
