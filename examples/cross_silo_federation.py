@@ -40,9 +40,11 @@ if secagg:
     server = SecAggServerManager(mk(0), client_ids=client_ids,
                                  init_params=params, num_rounds=3)
 else:
+    # quorum 2-of-3: math.ceil(quorum_frac * 3) must equal 2, so use the
+    # exact fraction (0.67 would ceil to 3 and demand every client)
     server = FedServerManager(mk(0), client_ids=client_ids,
                               init_params=params, num_rounds=3,
-                              round_timeout=30.0, quorum_frac=0.67)
+                              round_timeout=30.0, quorum_frac=2 / 3)
 
 rs = np.random.RandomState(0)
 w_true = rs.randn(8, 3)

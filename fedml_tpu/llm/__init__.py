@@ -108,11 +108,15 @@ def make_fedllm_seq_round(
         attn_fn = functools.partial(ulysses_attention, axis_name=seq_axis)
     else:
         raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
-    # same architecture, sequence-parallel attention bound to the mesh axis
+    # same architecture, sequence-parallel attention bound to the mesh axis;
+    # compute_dtype honored like the flat path (mixed_precision_apply)
+    from ..models.hub import mixed_precision_apply
+
     spmodel = TransformerLM(
         vocab_size=model.vocab_size, d_model=model.d_model,
         n_layers=model.n_layers, n_heads=model.n_heads, d_ff=model.d_ff,
         attn_fn=attn_fn)
+    sp_apply = mixed_precision_apply(spmodel.apply, t.compute_dtype)
     opt = optax.sgd(t.learning_rate,
                     momentum=t.momentum if t.momentum else None)
 
@@ -131,7 +135,7 @@ def make_fedllm_seq_round(
 
             def loss_sum(a):
                 merged = lora_merge(base, a, alpha)
-                logits = spmodel.apply(
+                logits = sp_apply(
                     {"params": merged}, batch["x"], pos_offset=off)
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, batch["y"])                       # [B, T_loc]
