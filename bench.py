@@ -301,31 +301,52 @@ def bench_fedllm(quick: bool = False) -> dict:
     }
 
 
+def _retrying(fn, *a, attempts=2, default=None, **kw):
+    """The remote-TPU tunnel occasionally hiccups; the driver runs this
+    file ONCE, so sub-benches retry and degrade instead of killing the
+    whole line."""
+    for i in range(attempts):
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001
+            err = f"{type(e).__name__}: {e}"
+            print(f"bench sub-step {fn.__name__} attempt {i + 1} failed: "
+                  f"{err[:300]}", file=sys.stderr)
+    return default
+
+
 def main():
     quick = "--quick" in sys.argv
-    tpu_rps, round_time, flops, synthetic = bench_tpu()
-    peak = measured_matmul_peak_tflops()
+    tpu_rps, round_time, flops, synthetic = _retrying(
+        bench_tpu, default=(None, None, None, None))
+    if tpu_rps is None:
+        print(json.dumps({"metric": "fedavg_rounds_per_sec_100clients_"
+                          "resnet18_cifar10", "value": None,
+                          "unit": "rounds/sec", "vs_baseline": None,
+                          "error": "bench_tpu failed twice"}))
+        return 1
+    peak = _retrying(measured_matmul_peak_tflops, default=None)
     achieved = (flops / round_time) / 1e12 if flops else None
-    acc = bench_accuracy_real()
-    base_rps = bench_torch_baseline(2 if quick else 4)
-    try:
-        llm = bench_fedllm(quick=quick)
-        if quick:
-            llm["fedllm_quick_size"] = True
-    except Exception as e:  # the headline metric must survive an LLM hiccup
-        llm = {"fedllm_error": f"{type(e).__name__}: {e}"}
+    acc = _retrying(bench_accuracy_real, default=None)
+    base_rps = _retrying(bench_torch_baseline, 2 if quick else 4,
+                         default=None)
+    llm = _retrying(bench_fedllm, quick=quick, default=None)
+    if llm is None:
+        llm = {"fedllm_error": "bench_fedllm failed twice"}
+    elif quick:
+        llm["fedllm_quick_size"] = True
     print(json.dumps({
         "metric": "fedavg_rounds_per_sec_100clients_resnet18_cifar10",
         "value": round(tpu_rps, 4),
         "unit": "rounds/sec",
-        "vs_baseline": round(tpu_rps / base_rps, 2),
+        "vs_baseline": round(tpu_rps / base_rps, 2) if base_rps else None,
         "round_time_ms": round(round_time * 1e3, 1),
         "achieved_tflops": round(achieved, 2) if achieved else None,
-        "matmul_peak_tflops_measured": round(peak, 1),
-        "mfu_vs_matmul_peak": round(achieved / peak, 3) if achieved else None,
+        "matmul_peak_tflops_measured": round(peak, 1) if peak else None,
+        "mfu_vs_matmul_peak": round(achieved / peak, 3) if (achieved and peak) else None,
         "compute_dtype": "bfloat16",
         "data_synthetic": synthetic,
-        "real_data_final_acc_digits_noniid": round(acc, 4),
+        "real_data_final_acc_digits_noniid": round(acc, 4) if acc is not None else None,
         **llm,
         "baseline_note": "torch-CPU re-creation of reference sp/fedavg loop "
                          "(reference is CPU/CUDA torch; no GPU in container)",
@@ -333,4 +354,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)
