@@ -1,9 +1,19 @@
 """CLI — `python -m fedml_tpu <cmd>`.
 
-(reference: python/fedml/cli/cli.py — click commands `fedml version / env /
-run / launch / ...`; the cloud-platform commands (login/build/launch) have
-no meaning without the FedML SaaS, so the CLI here covers the local
-surface: version, env report, config-driven runs, and the benchmark.)
+(reference: python/fedml/cli/cli.py:18-76 — click commands `fedml version /
+env / run / launch / build / logs / diagnosis / ...`. The SaaS-bound legs
+(login, OTA) have no meaning without a cloud; everything else has a
+local-first analog here:
+  version/env  — runtime report
+  run          — config-driven run (fedml_config.yaml accepted unchanged)
+  launch       — submit a job spec through the scheduler tier
+                 (MasterAgent + WorkerAgent + optional sqlite store)
+  build        — package a job directory into a distributable tarball
+                 (reference: cli/build: client/server package builder)
+  logs         — tail per-run logs/events written by the mlops facade
+  diagnosis    — transport + device connectivity checks (reference:
+                 slave/client_diagnosis.py MQTT/S3 probes)
+  bench        — run the repo benchmark)
 """
 from __future__ import annotations
 
@@ -84,6 +94,195 @@ def cmd_bench(_args) -> int:
     return subprocess.call([sys.executable, os.path.join(root, "bench.py")])
 
 
+def cmd_launch(args) -> int:
+    """Submit a job spec through the scheduler tier (reference: `fedml
+    launch job.yaml` submits to the Launch platform; here the MasterAgent is
+    local-first — loopback by default, and durable when --store is given).
+    The job yaml/json is a scheduler spec: {"type": "simulation"|"python"|
+    "serve", ..., "requirements": {...}}."""
+    import uuid
+
+    import yaml
+
+    from .comm import FedCommManager
+    from .comm.loopback import LoopbackTransport, release_router
+    from .scheduler import MasterAgent, WorkerAgent
+
+    with open(args.job) as f:
+        spec = yaml.safe_load(f)
+    run_id = f"launch-{uuid.uuid4().hex[:6]}"
+    master = MasterAgent(FedCommManager(LoopbackTransport(0, run_id), 0),
+                         store_path=args.store)
+    worker = WorkerAgent(FedCommManager(LoopbackTransport(1, run_id), 1), 1)
+    master.run()
+    worker.run()
+    worker.announce()
+    jid = master.submit(spec)
+    job = master.wait(jid, timeout=args.timeout)
+    print(json.dumps({"job_id": jid, "status": job.status,
+                      "result": _jsonable(job.result)}))
+    master.stop()
+    worker.stop()
+    release_router(run_id)
+    return 0 if job.status == "FINISHED" else 1
+
+
+def _jsonable(x):
+    try:
+        json.dumps(x)
+        return x
+    except (TypeError, ValueError):
+        return repr(x)
+
+
+def cmd_build(args) -> int:
+    """Package a job directory into a distributable tarball with a manifest
+    (reference: cli/cli.py `fedml build` — client/server package builder;
+    the package here is source + entry + sha256 manifest, consumable by
+    `launch` on any host with fedml_tpu installed)."""
+    import hashlib
+    import os
+    import tarfile
+    import time
+
+    src = os.path.abspath(args.source)
+    if not os.path.isdir(src):
+        print(f"source dir not found: {src}", file=sys.stderr)
+        return 1
+    entry = args.entry
+    if entry and not os.path.exists(os.path.join(src, entry)):
+        print(f"entry {entry!r} not found under {src}", file=sys.stderr)
+        return 1
+    name = args.name or os.path.basename(src.rstrip("/"))
+    os.makedirs(args.dest, exist_ok=True)
+    out = os.path.join(args.dest, f"{name}.tar.gz")
+    manifest = {"name": name, "entry": entry, "created": time.time(),
+                "files": {}}
+    for root, _dirs, files in os.walk(src):
+        for fn in sorted(files):
+            p = os.path.join(root, fn)
+            rel = os.path.relpath(p, src)
+            with open(p, "rb") as f:
+                manifest["files"][rel] = hashlib.sha256(f.read()).hexdigest()
+    # the manifest goes into the tarball from memory — writing it into the
+    # user's source dir could clobber a pre-existing fedml_manifest.json
+    import io
+
+    man_bytes = json.dumps(manifest, indent=2).encode()
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(src, arcname=name)
+        info = tarfile.TarInfo(f"{name}/fedml_manifest.json")
+        info.size = len(man_bytes)
+        info.mtime = int(manifest["created"])
+        tar.addfile(info, io.BytesIO(man_bytes))
+    print(json.dumps({"package": out, "files": len(manifest["files"]),
+                      "entry": entry}))
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """Print per-run logs/events the mlops facade wrote (reference: `fedml
+    logs` pulls run logs; local-first: they're already on disk under
+    tracking_args.log_file_dir)."""
+    import os
+
+    d = args.log_dir
+    if not os.path.isdir(d):
+        print(f"no log dir {d!r}", file=sys.stderr)
+        return 1
+    names = sorted(os.listdir(d))
+    if args.run is not None:
+        names = [n for n in names if n.startswith(args.run)]
+    if args.list or not names:
+        print(json.dumps({"log_dir": d, "runs": names}))
+        return 0
+    for n in names:
+        p = os.path.join(d, n)
+        if not os.path.isfile(p):
+            continue
+        with open(p) as f:
+            lines = f.readlines()
+        for line in lines[-args.tail:]:
+            sys.stdout.write(f"[{n}] {line}")
+    return 0
+
+
+def cmd_diagnosis(args) -> int:
+    """Connectivity / capability checks (reference:
+    slave/client_diagnosis.py — MQTT + S3 probes before joining a run).
+    Probes every transport the comm layer offers plus the device runtime;
+    exit 0 iff everything required works."""
+    import uuid
+
+    checks: dict = {}
+
+    def check(name, fn):
+        try:
+            checks[name] = {"ok": True, **(fn() or {})}
+        except Exception as e:  # noqa: BLE001 — each probe reports
+            checks[name] = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"[:200]}
+
+    def jax_devices():
+        import jax
+
+        return {"backend": jax.default_backend(),
+                "devices": len(jax.devices())}
+
+    def loopback():
+        from .comm import FedCommManager, Message
+        from .comm.loopback import LoopbackTransport, release_router
+
+        run = f"diag-{uuid.uuid4().hex[:6]}"
+        import threading
+
+        got = threading.Event()
+        a = FedCommManager(LoopbackTransport(0, run), 0)
+        b = FedCommManager(LoopbackTransport(1, run), 1)
+        b.register_message_receive_handler("ping", lambda m: got.set())
+        a.run(background=True)
+        b.run(background=True)
+        a.send_message(Message("ping", 0, 1))
+        ok = got.wait(timeout=5)
+        a.stop(); b.stop(); release_router(run)
+        if not ok:
+            raise TimeoutError("loopback roundtrip timed out")
+
+    def grpc():
+        from .comm.grpc_transport import GrpcTransport
+
+        # bind-probe on an ephemeral port proves the stack is usable
+        t = GrpcTransport(0, {}, port=0)
+        t.shutdown(grace=0)
+
+    def native():
+        from .native import crc32c
+
+        if crc32c(b"x") is None:
+            raise RuntimeError("native lib unavailable (pure-python "
+                               "fallbacks active — functional, slower)")
+
+    def wire():
+        import numpy as np
+
+        from .comm.serialization import decode, encode
+
+        x = {"a": np.arange(8, dtype=np.float32)}
+        got = decode(encode(x))
+        if not np.array_equal(got["a"], x["a"]):
+            raise ValueError("wire codec roundtrip mismatch")
+
+    check("jax", jax_devices)
+    check("wire_codec", wire)
+    check("loopback_transport", loopback)
+    check("grpc_transport", grpc)
+    check("native_lib", native)
+    required_ok = all(checks[k]["ok"] for k in
+                      ("jax", "wire_codec", "loopback_transport"))
+    print(json.dumps({"ok": required_ok, "checks": checks}, indent=2))
+    return 0 if required_ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="fedml_tpu",
@@ -97,9 +296,27 @@ def main(argv=None) -> int:
     runp.add_argument("--rounds", type=int, default=None,
                       help="override comm_round")
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")
+    lp = sub.add_parser("launch", help="submit a job spec to the scheduler")
+    lp.add_argument("job", help="job spec yaml/json (scheduler spec)")
+    lp.add_argument("--store", default=None,
+                    help="sqlite path for a durable job queue")
+    lp.add_argument("--timeout", type=float, default=600.0)
+    bp = sub.add_parser("build", help="package a job dir into a tarball")
+    bp.add_argument("--source", required=True, help="job directory")
+    bp.add_argument("--entry", default=None, help="entry file inside source")
+    bp.add_argument("--dest", default="./dist", help="output directory")
+    bp.add_argument("--name", default=None, help="package name")
+    gp = sub.add_parser("logs", help="show per-run logs/events")
+    gp.add_argument("--log-dir", default="./log")
+    gp.add_argument("--run", default=None, help="run-name prefix filter")
+    gp.add_argument("--tail", type=int, default=50)
+    gp.add_argument("--list", action="store_true", help="list runs only")
+    sub.add_parser("diagnosis",
+                   help="transport/device connectivity checks")
     args = p.parse_args(argv)
     return {"version": cmd_version, "env": cmd_env, "run": cmd_run,
-            "bench": cmd_bench}[args.cmd](args)
+            "bench": cmd_bench, "launch": cmd_launch, "build": cmd_build,
+            "logs": cmd_logs, "diagnosis": cmd_diagnosis}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -107,12 +107,17 @@ class GrpcTransport(BaseTransport):
             self._notify(Message.decode(frame))
 
     def stop_receive_message(self) -> None:
+        self.shutdown(grace=1.0)
+
+    def shutdown(self, grace: float = 1.0) -> None:
+        """Release the server port and peer channels. grace=0 for bind-probes
+        (`fedml_tpu diagnosis`); the default waits out in-flight RPCs —
+        peers may still be sending their final acks (C2S_FINISHED), and
+        tearing the executor down under an in-flight accept raises noisy
+        "cannot schedule new futures after shutdown" on the serve thread."""
         self._running = False
         self._inbox.put(None)
-        # stop the server FIRST and wait out the grace period: peers may
-        # still be sending their final acks (C2S_FINISHED), and tearing the
-        # executor down under an in-flight accept raises noisy
-        # "cannot schedule new futures after shutdown" on the serve thread
-        self._server.stop(grace=1.0).wait(timeout=2.0)
+        self._server.stop(grace=grace).wait(timeout=2.0)
         for ch in self._channels.values():
             ch.close()
+        self._channels.clear()

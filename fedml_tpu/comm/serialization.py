@@ -21,7 +21,8 @@ from typing import Any
 import numpy as np
 
 Pytree = Any
-_MAGIC = b"FT01"
+_MAGIC = b"FT01"        # trailer-less frame
+_MAGIC_CRC = b"FT02"    # frame with a CRC-32C trailer (last 8 bytes)
 
 
 def _encode_obj(obj: Any, buffers: list[bytes]):
@@ -73,41 +74,48 @@ _CRC_TAG = b"C32C"
 
 def encode(tree: Pytree) -> bytes:
     """pytree (dict/list/scalars/ndarray/jax arrays) -> framed bytes.
-    When the native tier is available, a CRC-32C trailer is appended
-    (native/fedml_native.cpp crc32c) so transport corruption surfaces as a
-    clean ValueError instead of silently-wrong tensors. Receivers without
-    the native lib skip verification; FT01 frames without a trailer decode
-    unchanged."""
+    When the native tier is available, the frame is tagged FT02 and a
+    CRC-32C trailer is appended (native/fedml_native.cpp crc32c) so
+    transport corruption surfaces as a clean ValueError instead of
+    silently-wrong tensors. The magic — not content sniffing — decides
+    whether a trailer exists: a tensor payload that happens to end with the
+    tag bytes can never be misparsed as a trailer. Senders without the
+    native lib emit trailer-less FT01; FT02 receivers without it strip the
+    trailer unverified."""
     buffers: list[bytes] = []
     header = _encode_obj(tree, buffers)
     sizes = [len(b) for b in buffers]
     head = json.dumps({"tree": header, "sizes": sizes}).encode()
-    frame = b"".join([_MAGIC, struct.pack("<I", len(head)), head] + buffers)
     from ..native import crc32c
 
-    crc = crc32c(frame)
-    if crc is not None:
-        frame += _CRC_TAG + struct.pack("<I", crc)
-    return frame
+    body = b"".join([struct.pack("<I", len(head)), head] + buffers)
+    crc = crc32c(_MAGIC_CRC + body)
+    if crc is None:
+        return _MAGIC + body
+    return _MAGIC_CRC + body + _CRC_TAG + struct.pack("<I", crc)
 
 
 def decode(data: bytes | memoryview) -> Pytree:
     data = memoryview(data)
-    if bytes(data[:4]) != _MAGIC:
+    magic = bytes(data[:4])
+    if magic not in (_MAGIC, _MAGIC_CRC):
         raise ValueError("bad frame magic (not a fedml_tpu wire frame)")
     # integrity trailer FIRST: corruption anywhere (including the JSON
     # header) must surface as a CRC error, not a parse error
-    if len(data) >= 12 and bytes(data[-8:-4]) == _CRC_TAG:
+    if magic == _MAGIC_CRC:
+        if len(data) < 16:
+            raise ValueError("FT02 frame too short for its CRC trailer")
+        if bytes(data[-8:-4]) != _CRC_TAG:
+            raise ValueError("FT02 frame missing its CRC trailer tag")
         from ..native import crc32c
 
         (want,) = struct.unpack("<I", data[-4:])
         got = crc32c(data[:-8])  # memoryview: zero-copy into the kernel
-        if got is not None:
-            if got != want:
-                raise ValueError(
-                    f"wire frame CRC mismatch (got {got:#x}, want "
-                    f"{want:#x}) — payload corrupted in transit")
-            data = data[:-8]
+        if got is not None and got != want:
+            raise ValueError(
+                f"wire frame CRC mismatch (got {got:#x}, want "
+                f"{want:#x}) — payload corrupted in transit")
+        data = data[:-8]
     (hlen,) = struct.unpack("<I", data[4:8])
     head = json.loads(bytes(data[8 : 8 + hlen]))
     buffers: list[memoryview] = []

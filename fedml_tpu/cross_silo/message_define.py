@@ -47,3 +47,6 @@ KEY_SA_B_SHARES = "sa_b_shares"
 KEY_SA_SK_SHARES = "sa_sk_shares"
 KEY_SA_THRESHOLD = "sa_threshold"
 KEY_SA_QBITS = "sa_q_bits"
+# N = sum(n_i): broadcast with the pk list so clients mask normalized
+# weights n_i/N (field budget stays count-scale-free)
+KEY_SA_WEIGHT_NORM = "sa_weight_norm"

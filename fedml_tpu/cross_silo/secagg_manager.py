@@ -8,10 +8,12 @@ routing via the server (5/6) → masked model upload (7) → active-client list
 (10) → survivors' shares of others (11) → unmask. This module is the same
 protocol over fedml_tpu's comm layer, driving mpc/secagg.py:
 
-  setup (once):  C2S_SA_PK → S2C_SA_PKS → C2S_SA_SHARES (routed) →
+  setup (once):  C2S_SA_PK (+ n_i) → S2C_SA_PKS (+ weight norm) →
+                 C2S_SA_SHARES (encrypted-to-holder, routed & DISCARDED) →
                  S2C_SA_SHARES (+ init model, starts round 0)
-  per round:     train → C2S_SA_MASKED (masked weighted params, n clear)
-                 all received → unmask (self-masks from shares) → next round
+  per round:     train → C2S_SA_MASKED (masked normalized-weighted params)
+                 all received → S2C_SA_UNMASK_REQ(survivors) →
+                 C2S_SA_UNMASK (b-shares of survivors) → unmask → next round
   dropout:       round_timeout fires → S2C_SA_UNMASK_REQ(survivors, dropped)
                  → C2S_SA_UNMASK (b-shares of survivors + sk-shares of
                  dropped) → reconstruct sk_j → strip pairwise masks → next
@@ -19,17 +21,26 @@ protocol over fedml_tpu's comm layer, driving mpc/secagg.py:
                  pairwise masks they would have contributed are stripped
                  every round thereafter via the reconstructed seeds).
 
-Weighted mean under masking: clients mask quantize(params * n_i) and send
-n_i in the clear (weights are public in the reference too); the server
-divides the unmasked sum by sum(n_i). Magnitudes must satisfy
-|param| * n_i * m * 2^q_bits < p/2 — with the default 31-bit prime and
-q_bits=16 that allows sum(|param_i| * n_i) up to ~16k, plenty for cross-silo
-client counts; lower q_bits for bigger fleets.
+Server-side privacy: routed setup shares are ENCRYPTED to their holder
+(mpc/secagg.py encrypt_share, pad derived from the owner-holder DH secret)
+and the server deletes each ciphertext batch right after forwarding — it
+never holds t+1 shares of anyone's b_i or sk_i, so it cannot reconstruct a
+client's masks and unmask an individual update. The b-shares it needs to
+strip self-masks are collected fresh from t+1 survivors every round
+(Bonawitz et al.'s round-4 disclosure: b_i of survivors is by-design safe to
+reconstruct because their pairwise masks remain).
+
+Weighted mean under masking: clients mask quantize(params * n_i / N) where
+N = sum(n_i) is broadcast with the pk list, and send n_i in the clear
+(weights are public in the reference too); the server divides the unmasked
+sum by sum(n_i)/N. Normalizing by N keeps the field budget independent of
+absolute sample counts (raw counts in the thousands would overflow the
+default q_bits=16 x 31-bit-prime budget); SecAggClient.mask validates the
+budget and raises rather than silently wrapping.
 
 SECURITY SCOPE: inherits mpc/secagg.py's simulation-grade primitives (DH
-over the field prime, non-cryptographic PRG) and routes shares through the
-server unencrypted; see that module's docstring for the production
-substitution (X25519 + keyed PRF + per-holder encryption of shares).
+over the field prime, non-cryptographic PRG); see that module's docstring
+for the production substitution (X25519 + keyed PRF).
 """
 from __future__ import annotations
 
@@ -41,7 +52,9 @@ import jax
 import numpy as np
 
 from ..comm import FedCommManager, Message
-from ..mpc.secagg import SecAggClient, SecAggServer
+from ..mpc.secagg import (
+    SecAggClient, SecAggServer, decrypt_share, encrypt_share,
+)
 from ..utils.events import recorder
 from . import message_define as md
 from .trainer import SiloTrainer
@@ -94,8 +107,14 @@ class SecAggServerManager:
         self.server = SecAggServer(self.n, self.t, self.dim, q_bits=q_bits)
 
         self.pks: dict[int, int] = {}
-        # routed setup shares: shares_for[holder][owner] = {"b":..,"sk":..}
-        self.shares_for: dict[int, dict[int, dict]] = {c: {} for c in client_ids}
+        self.client_counts: dict[int, float] = {}   # n_i sent with the pk
+        self._pks_broadcast = False
+        self.weight_norm = 1.0                      # N = sum(n_i), set at pks
+        # transient routing buffer: _route_buf[holder][owner] = ciphertext
+        # {"b":..,"sk":..}; DELETED right after forwarding — the server must
+        # never retain share material (see module docstring)
+        self._route_buf: Optional[dict[int, dict[int, dict]]] = {
+            c: {} for c in client_ids}
         self.masked: dict[int, tuple[np.ndarray, float]] = {}
         self.active: set[int] = set(client_ids)      # not yet dropped
         self.dropped_sk: dict[int, int] = {}         # dropped id -> sk
@@ -110,6 +129,7 @@ class SecAggServerManager:
         self.dropped_log: list[tuple[int, list[int]]] = []
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._timer_gen = 0
         self._rearm_count = 0
         self.max_rearms = 5   # below-quorum retries before declaring failure
 
@@ -151,24 +171,41 @@ class SecAggServerManager:
 
     def _on_pk(self, msg: Message) -> None:
         with self._lock:
+            if self._pks_broadcast:
+                # a redelivered pk after the broadcast must not trigger a
+                # second S2C_SA_PKS: clients would re-draw fresh Shamir
+                # polynomials and later reconstruction would silently mix
+                # shares of different polynomials into a garbage seed
+                return
             self.pks[msg.sender_id] = int(msg.get(md.KEY_SA_PK))
+            self.client_counts[msg.sender_id] = float(
+                msg.get(md.KEY_NUM_SAMPLES, 1.0))
             if len(self.pks) < self.n:
                 return
+            self._pks_broadcast = True
+            # N = sum(n_i): clients normalize their mask weights by it so
+            # the field budget is count-scale-free (module docstring)
+            self.weight_norm = max(sum(self.client_counts.values()), 1.0)
             pks_wire = {str(c): self.pks[c] for c in self.client_ids}
             for cid in self.client_ids:
                 m = Message(md.S2C_SA_PKS, 0, cid)
                 m.add(md.KEY_SA_PKS, pks_wire)
+                m.add(md.KEY_SA_WEIGHT_NORM, self.weight_norm)
                 self.comm.send_message(m)
 
     def _on_shares(self, msg: Message) -> None:
-        """Route each client's shares to their holders (the server is the
-        relay, as in the reference: S2C_OTHER_SS_TO_CLIENT)."""
+        """Route each client's encrypted shares to their holders (the server
+        is the relay, as in the reference: S2C_OTHER_SS_TO_CLIENT) and drop
+        the ciphertexts immediately after forwarding."""
         owner = msg.sender_id
-        shares = msg.get(md.KEY_SA_SHARES)  # {holder_str: {"b":.., "sk":..}}
+        shares = msg.get(md.KEY_SA_SHARES)  # {holder_str: enc {"b":.., "sk":..}}
         with self._lock:
+            if self._route_buf is None:
+                return  # late duplicate after setup completed
             for holder_s, sh in shares.items():
-                self.shares_for[int(holder_s)][owner] = sh
-            ready = all(len(self.shares_for[c]) == self.n
+                self._route_buf[int(holder_s)][owner] = sh
+            # n-1 per holder: each client keeps its own share locally
+            ready = all(len(self._route_buf[c]) == self.n - 1
                         for c in self.client_ids)
             if not ready:
                 return
@@ -176,10 +213,11 @@ class SecAggServerManager:
             for cid in self.client_ids:
                 m = Message(md.S2C_SA_SHARES, 0, cid)
                 m.add(md.KEY_SA_SHARES,
-                      {str(o): sh for o, sh in self.shares_for[cid].items()})
+                      {str(o): sh for o, sh in self._route_buf[cid].items()})
                 m.add(md.KEY_MODEL_PARAMS, self.params)
                 m.add(md.KEY_ROUND, self.round_idx)
                 self.comm.send_message(m)
+            self._route_buf = None  # never retain share material
             self._arm_timer()
 
     def _on_masked(self, msg: Message) -> None:
@@ -196,27 +234,32 @@ class SecAggServerManager:
                 float(msg.get(md.KEY_NUM_SAMPLES, 1.0)),
             )
             if set(self.masked) >= self.active:
-                self._unmask_and_advance()
+                self._begin_unmask()
 
     # ---------------------------------------------------- dropout recovery
     def _arm_timer(self) -> None:
         if self.round_timeout is None:
             return
         self._cancel_timer()
+        # generation counter, not round index: a stale callback can already
+        # be blocked on the lock when a phase transition (masked-complete ->
+        # begin_unmask) re-arms within the same round; comparing round_idx
+        # would let it fire into the new phase and spuriously fail the run
         t = threading.Timer(self.round_timeout, self._on_timeout,
-                            args=(self.round_idx,))
+                            args=(self._timer_gen,))
         t.daemon = True
         t.start()
         self._timer = t
 
     def _cancel_timer(self) -> None:
+        self._timer_gen += 1   # invalidate any in-flight stale callback
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
 
-    def _on_timeout(self, armed_round: int) -> None:
+    def _on_timeout(self, gen: int) -> None:
         with self._lock:
-            if self.done.is_set() or armed_round != self.round_idx:
+            if self.done.is_set() or gen != self._timer_gen:
                 return
             if self._awaiting_unmask:
                 # survivors' unmask replies never reached t+1 — a survivor
@@ -248,24 +291,33 @@ class SecAggServerManager:
                         sorted(dropped_now))
             self.dropped_log.append((self.round_idx, sorted(dropped_now)))
             self.active -= dropped_now
-            self._awaiting_unmask = True
-            self.unmask_b.clear()
-            self.unmask_sk.clear()
-            need_sk = [j for j in dropped_now if j not in self.dropped_sk]
-            for cid in survivors:
-                m = Message(md.S2C_SA_UNMASK_REQ, 0, cid)
-                m.add(md.KEY_SA_SURVIVORS, survivors)
-                m.add(md.KEY_SA_DROPPED, sorted(need_sk))
-                self.comm.send_message(m)
-            # guard the collection phase too: a survivor can die before
-            # replying with its shares
-            self._arm_timer()
+            self._begin_unmask(dropped_now)
 
     def _fail(self, reason: str) -> None:
         """Caller holds the lock. Record the error and shut down."""
         log.error("secagg run failed: %s", reason)
         self.error = reason
         self._finish()
+
+    def _begin_unmask(self, dropped_now: Optional[set] = None) -> None:
+        """Caller holds the lock. EVERY round ends with a fresh collection of
+        b-shares from t+1 survivors (the server retains no share material);
+        after a dropout the same request also gathers sk-shares of the
+        newly-dropped."""
+        self._cancel_timer()
+        survivors = sorted(self.active & set(self.masked))
+        self._awaiting_unmask = True
+        self.unmask_b.clear()
+        self.unmask_sk.clear()
+        need_sk = sorted(j for j in (dropped_now or set())
+                         if j not in self.dropped_sk)
+        for cid in survivors:
+            m = Message(md.S2C_SA_UNMASK_REQ, 0, cid)
+            m.add(md.KEY_SA_SURVIVORS, survivors)
+            m.add(md.KEY_SA_DROPPED, need_sk)
+            self.comm.send_message(m)
+        # guard the collection phase: a survivor can die before replying
+        self._arm_timer()
 
     def _on_unmask(self, msg: Message) -> None:
         holder = msg.sender_id
@@ -280,7 +332,7 @@ class SecAggServerManager:
                 for o, v in msg.get(md.KEY_SA_SK_SHARES, {}).items()}
             if len(self.unmask_b) >= self.t + 1:
                 self._awaiting_unmask = False
-                self._unmask_and_advance(use_collected=True)
+                self._unmask_and_advance()
 
     # ------------------------------------------------------------- rounds
     def _proto(self, cid: int) -> int:
@@ -289,30 +341,23 @@ class SecAggServerManager:
         protocol indices; everything crosses this boundary here."""
         return self.client_ids.index(cid)
 
-    def _unmask_and_advance(self, use_collected: bool = False) -> None:
-        """Caller holds the lock. Unmask the survivor sum and advance."""
+    def _unmask_and_advance(self) -> None:
+        """Caller holds the lock. Unmask the survivor sum (b-shares freshly
+        collected from survivors — _begin_unmask) and advance."""
         self._cancel_timer()
         survivors = sorted(self.masked)
         pr = self._proto
-        # b-shares: full participation -> from the routed setup shares;
-        # after a dropout -> from the survivors' unmask responses
-        if use_collected:
-            b_shares = {pr(h): {pr(o): sh for o, sh in shares.items()}
-                        for h, shares in self.unmask_b.items()}
-            # reconstruct newly-dropped clients' sk from survivor shares
-            per_owner: dict[int, dict[int, np.ndarray]] = {}
-            for holder, shares in self.unmask_sk.items():
-                for owner, sh in shares.items():
-                    per_owner.setdefault(owner, {})[pr(holder)] = sh
-            for owner, shs in per_owner.items():
-                if len(shs) >= self.t + 1:
-                    self.dropped_sk[owner] = SecAggServer.reconstruct_sk(
-                        dict(sorted(shs.items())[: self.t + 1]))
-        else:
-            b_shares = {
-                pr(h): {pr(o): np.asarray(sh["b"], np.int64)
-                        for o, sh in self.shares_for[h].items()}
-                for h in survivors}
+        b_shares = {pr(h): {pr(o): sh for o, sh in shares.items()}
+                    for h, shares in self.unmask_b.items()}
+        # reconstruct newly-dropped clients' sk from survivor shares
+        per_owner: dict[int, dict[int, np.ndarray]] = {}
+        for holder, shares in self.unmask_sk.items():
+            for owner, sh in shares.items():
+                per_owner.setdefault(owner, {})[pr(holder)] = sh
+        for owner, shs in per_owner.items():
+            if len(shs) >= self.t + 1:
+                self.dropped_sk[owner] = SecAggServer.reconstruct_sk(
+                    dict(sorted(shs.items())[: self.t + 1]))
         pair_seeds = {
             pr(j): {pr(i): SecAggServer.pairwise_seed(sk, self.pks[i])
                     for i in survivors}
@@ -322,7 +367,8 @@ class SecAggServerManager:
             total = self.server.aggregate(
                 {pr(i): y for i, (y, _n) in self.masked.items()},
                 b_shares, pair_seeds, round_salt=self.round_idx)
-        wsum = sum(n for (_y, n) in self.masked.values())
+        # clients masked params * (n_i / N): divide by sum(n_i)/N
+        wsum = sum(n for (_y, n) in self.masked.values()) / self.weight_norm
         vec = total / max(wsum, 1e-9)
         self.params = unflatten_params(self.params, vec)
 
@@ -383,6 +429,8 @@ class SecAggClientManager:
         self.sa: Optional[SecAggClient] = None
         self.pks: dict[int, int] = {}          # protocol idx -> pk
         self.recv_shares: dict[int, dict] = {}  # owner proto idx -> {"b","sk"}
+        self._self_share: dict = {}             # this client's own b/sk share
+        self.weight_norm = 1.0                  # N = sum(n_i), from S2C_SA_PKS
         self.done = threading.Event()
 
         h = comm.register_message_receive_handler
@@ -412,24 +460,47 @@ class SecAggClientManager:
                                seed=self._seed + self.client_id)
         m = Message(md.C2S_SA_PK, self.client_id, self.server_id)
         m.add(md.KEY_SA_PK, self.sa.public_key())
+        # n_i rides with the pk so the server can broadcast N = sum(n_i)
+        # (sample counts are public in this protocol, as in the reference)
+        m.add(md.KEY_NUM_SAMPLES, self.trainer.n_samples)
         self.comm.send_message(m)
 
     def _on_pks(self, msg: Message) -> None:
         # wire pks keyed by client id; protocol works on 0..n-1 indices
         self.pks = {self._cid_to_proto(int(c)): int(pk)
                     for c, pk in msg.get(md.KEY_SA_PKS).items()}
+        self.weight_norm = float(msg.get(md.KEY_SA_WEIGHT_NORM, 1.0))
         b_shares = self.sa.share_self_seed()    # [n, 1]
         sk_shares = self.sa.share_sk()
+        # this client's own share never leaves the process: routing it
+        # (even encrypted to itself) would hand the server one real Shamir
+        # share of b_i/sk_i, weakening the reconstruction threshold by one
+        self._self_share = {"b": b_shares[self.proto_idx],
+                            "sk": sk_shares[self.proto_idx]}
         out = Message(md.C2S_SA_SHARES, self.client_id, self.server_id)
-        out.add(md.KEY_SA_SHARES, {
-            str(self.client_ids[h]): {"b": b_shares[h], "sk": sk_shares[h]}
-            for h in range(self.n)})
+        # each holder's shares are encrypted with the owner-holder DH pad:
+        # the routing server sees only ciphertext (module docstring)
+        enc = {}
+        for h in range(self.n):
+            if h == self.proto_idx:
+                continue
+            sec = self.sa.agree(self.pks[h])
+            enc[str(self.client_ids[h])] = {
+                "b": encrypt_share(b_shares[h], sec, self.proto_idx, h, "b"),
+                "sk": encrypt_share(sk_shares[h], sec, self.proto_idx, h,
+                                    "sk")}
+        out.add(md.KEY_SA_SHARES, enc)
         self.comm.send_message(out)
 
     def _on_shares(self, msg: Message) -> None:
-        self.recv_shares = {
-            self._cid_to_proto(int(o)): sh
-            for o, sh in msg.get(md.KEY_SA_SHARES).items()}
+        self.recv_shares = {self.proto_idx: self._self_share}
+        for o, sh in msg.get(md.KEY_SA_SHARES).items():
+            owner = self._cid_to_proto(int(o))
+            sec = self.sa.agree(self.pks[owner])
+            self.recv_shares[owner] = {
+                "b": decrypt_share(sh["b"], sec, owner, self.proto_idx, "b"),
+                "sk": decrypt_share(sh["sk"], sec, owner, self.proto_idx,
+                                    "sk")}
         self._train_and_send(msg.get(md.KEY_MODEL_PARAMS),
                              int(msg.get(md.KEY_ROUND, 0)))
 
@@ -440,7 +511,8 @@ class SecAggClientManager:
     def _train_and_send(self, params, round_idx: int) -> None:
         with recorder.span("sa_train", round=round_idx, client=self.client_id):
             new_params, n, _metrics = self.trainer.train(params, round_idx)
-        vec = flatten_params(new_params) * float(n)
+        # normalized weight n/N keeps the field budget count-scale-free
+        vec = flatten_params(new_params) * (float(n) / self.weight_norm)
         masked = self.sa.mask(vec, self.pks, round_salt=round_idx)
         out = Message(md.C2S_SA_MASKED, self.client_id, self.server_id)
         out.add(md.KEY_SA_MASKED, masked)

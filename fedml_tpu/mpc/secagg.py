@@ -28,6 +28,7 @@ unchanged by that substitution.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Optional
 
 import numpy as np
@@ -38,6 +39,51 @@ from .finite import (
 )
 
 _G = 5  # public DH generator (reference: my_pk_gen uses g**sk mod p)
+
+
+def derive_round_key(seed: int, round_salt: int, label: bytes = b"mask") -> int:
+    """Per-round PRG key: SHA-256(label || seed || salt) truncated to 62 bits.
+
+    Additive salting (seed + salt) lets distinct (seed, salt) pairs collide
+    and produce related keystreams across rounds; hashing makes the per-round
+    key derivation a drop-in for a production PRF substitution (HKDF would
+    slot in here unchanged)."""
+    h = hashlib.sha256(
+        label + int(seed).to_bytes(16, "little", signed=False)
+        + int(round_salt).to_bytes(8, "little", signed=True)
+    ).digest()
+    return int.from_bytes(h[:8], "little") >> 2
+
+
+def _share_pad(pair_secret: int, owner: int, holder: int, field: str,
+               size: int, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Deterministic field-element pad for encrypting one routed share
+    payload: both endpoints of the (owner, holder) pair derive it from their
+    DH secret; the routing server cannot. `field` (e.g. "b" vs "sk")
+    domain-separates the keystream — reusing one pad for both payloads would
+    be a two-time pad leaking their difference (shares of b_i - sk_i) to
+    the router."""
+    key = derive_round_key(pair_secret, owner * 0x10001 + holder,
+                           label=b"share-enc:" + field.encode())
+    return prg_mask(key, size, p)
+
+
+def encrypt_share(share: np.ndarray, pair_secret: int, owner: int,
+                  holder: int, field: str, p: int = DEFAULT_PRIME
+                  ) -> np.ndarray:
+    """Encrypt a Shamir share (field elements) to its holder so the routing
+    server never sees plaintext shares (a server holding t+1 plaintext sk
+    shares could reconstruct any client's masks and unmask individual
+    updates — the aggregator is SecAgg's primary adversary)."""
+    s = np.mod(np.asarray(share, np.int64), p)
+    return (s + _share_pad(pair_secret, owner, holder, field, s.size, p)) % p
+
+
+def decrypt_share(cipher: np.ndarray, pair_secret: int, owner: int,
+                  holder: int, field: str, p: int = DEFAULT_PRIME
+                  ) -> np.ndarray:
+    c = np.mod(np.asarray(cipher, np.int64), p)
+    return (c - _share_pad(pair_secret, owner, holder, field, c.size, p)) % p
 
 
 @dataclasses.dataclass
@@ -89,16 +135,35 @@ class SecAggClient:
     # --- round 2: masked input
     def mask(self, x: np.ndarray, peer_pks: dict[int, int],
              round_salt: int = 0) -> np.ndarray:
-        """y_i = quantize(x_i) + PRG(b_i+salt) + sum_{j>i} PRG(s_ij+salt)
-        - sum_{j<i}. `round_salt` rotates every mask per round so the same
-        key material serves many rounds without mask reuse."""
+        """y_i = quantize(x_i) + PRG(H(b_i,salt)) + sum_{j>i} PRG(H(s_ij,salt))
+        - sum_{j<i}. `round_salt` rotates every mask per round (hash-derived
+        key, see derive_round_key) so the same key material serves many
+        rounds without mask reuse.
+
+        Validates the field magnitude budget before masking: the unmasked
+        SUM over all n clients must stay below p/2 after the 2^q_bits
+        quantization scale, or it silently wraps mod p and corrupts the
+        aggregate. Raises with remediation instead of wrapping."""
+        x = np.asarray(x, np.float64)
+        max_abs = float(np.max(np.abs(x))) if x.size else 0.0
+        budget = (self.p / 2.0) / (1 << self.q_bits)
+        if max_abs * self.num_clients >= budget:
+            raise ValueError(
+                f"secagg field overflow: max|x|={max_abs:.4g} x n="
+                f"{self.num_clients} clients exceeds the aggregate budget "
+                f"p/2^(q_bits+1)={budget:.4g}. Lower q_bits, or send "
+                f"normalized weights (n_i/n_total) instead of raw sample "
+                f"counts (SecAggClientManager does this when weight_norm "
+                f"is set).")
         D = x.size
         y = quantize(x, self.q_bits, self.p)
-        y = (y + prg_mask(self.self_seed + round_salt, D, self.p)) % self.p
+        key = derive_round_key(self.self_seed, round_salt)
+        y = (y + prg_mask(key, D, self.p)) % self.p
         for j, pk in peer_pks.items():
             if j == self.idx:
                 continue
-            pair = prg_mask(self.agree(pk) + round_salt, D, self.p)
+            pair = prg_mask(derive_round_key(self.agree(pk), round_salt),
+                            D, self.p)
             y = (y + pair) % self.p if j > self.idx else (y - pair) % self.p
         return y
 
@@ -145,14 +210,16 @@ class SecAggServer:
             seed = int(shamir_reconstruct(
                 np.stack([r.reshape(-1) for r in share_rows]), holders, self.p
             )[0])
-            agg = (agg - prg_mask(seed + round_salt, self.D, self.p)) % self.p
+            agg = (agg - prg_mask(derive_round_key(seed, round_salt),
+                                  self.D, self.p)) % self.p
 
         # strip pairwise masks involving dropped clients
         for j, seeds in pairwise_seeds_of_dropped.items():
             for i in survivors:
                 if i not in seeds:
                     continue
-                pair = prg_mask(seeds[i] + round_salt, self.D, self.p)
+                pair = prg_mask(derive_round_key(seeds[i], round_salt),
+                                self.D, self.p)
                 # client i applied +pair if j > i else -pair; remove it
                 agg = (agg - pair) % self.p if j > i else (agg + pair) % self.p
 

@@ -7,10 +7,13 @@ VMEM block specs, online-softmax accumulators, fori_loop over K blocks with
 causal block skipping).
 
 Scope:
-- forward: one pallas program per (batch*head, q-block): K/V stream through
-  VMEM in BLOCK_K slabs, (m, l, o) online-softmax accumulators in f32; the
-  causal structure skips fully-future K blocks (triangular schedule, ~2x
-  fewer MXU ops than dense).
+- forward: 3-D grid (batch*head, q-block, k-block). K/V genuinely stream
+  through VMEM one (BLOCK_K, D) slab per grid step — VMEM residency is
+  O(BLOCK·D), independent of T, so long contexts fit. The (m, l, o)
+  online-softmax accumulators live in VMEM scratch and carry across the
+  sequentially-executed k-block grid dimension; fully-future K blocks are
+  skipped via pl.when (their MXU work is elided; the slab DMA still runs —
+  a bandwidth cost, not a FLOP cost).
 - backward: custom_vjp with the standard flash recomputation expressed in
   blocked jax (scan over K blocks, saved LSE) — O(T·BLOCK) memory, exact
   gradients, jit-fused; a pallas backward kernel is a perf follow-up.
@@ -27,47 +30,55 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+_LANES = 128  # scratch minor dim: the TPU lane count; m/l stay lane-broadcast
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
-                block_k: int, seq_len: int, scale: float):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale                 # [BQ, D]
-    bq, d = q.shape
-    qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
+                block_q: int, block_k: int, scale: float):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    n_kb = pl.num_programs(2)
 
-    # only K blocks that intersect the causal triangle for this Q block
-    n_kb = ((qi + 1) * block_q + block_k - 1) // block_k
+    @pl.when(kj == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG)
+        l_acc[...] = jnp.zeros_like(l_acc)
 
-    def body(j, carry):
-        o, m, l = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # causal: K blocks entirely in this Q block's future contribute nothing
+    @pl.when(kj * block_k < (qi + 1) * block_q)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale             # [BQ, D]
+        bq, _d = q.shape
+        kb = k_ref[0].astype(jnp.float32)                    # [BK, D]
+        vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST)             # [BQ, BK]
-        kpos = j * block_k + jax.lax.broadcasted_iota(
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, 1), 0)
+        kpos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         s = jnp.where(qpos >= kpos, s, _NEG)
+        m = m_acc[:, :1]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=1, keepdims=True)
-        o = o * corr + jax.lax.dot_general(
+        l_new = l_acc[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST)
-        return o, m_new, l
+        m_acc[...] = jnp.broadcast_to(m_new, m_acc.shape)
+        l_acc[...] = jnp.broadcast_to(l_new, l_acc.shape)
 
-    o0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, n_kb, body, (o0, m0, l0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l).astype(o_ref.dtype)
+    @pl.when(kj == n_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_acc[:, :1], 1e-30)
+        o_ref[0] = (o_acc[...] / l).astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool):
@@ -76,20 +87,24 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int, interpret: bool):
     blocks; the backward recomputes it blockwise instead.)"""
     bh, t, d = q.shape
     scale = d ** -0.5
-    grid = (bh, t // block_q)
+    grid = (bh, t // block_q, t // block_k)
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, seq_len=t,
-        scale=scale)
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),        # o accumulator
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running sum l
+        ],
         interpret=interpret,
     )(q, k, v)
 

@@ -59,6 +59,10 @@ class ResourceMatcher:
         for wid, res in workers.items():
             if wid in busy:
                 continue
+            # pin-to-worker: lifecycle jobs (e.g. serve_stop) must land on
+            # the worker that owns the resource, not any capable one
+            if req.get("worker_id") is not None and wid != req["worker_id"]:
+                continue
             if res.get("devices", 0) < req.get("min_devices", 0):
                 continue
             if res.get("mem_mb", 0) < req.get("min_mem_mb", 0):
@@ -97,7 +101,8 @@ class MasterAgent:
     asynchronously (broker/grpc ordering is nondeterministic), so an
     instant verdict would race late registrations."""
 
-    def __init__(self, comm: FedCommManager, unmatchable_grace: float = 5.0):
+    def __init__(self, comm: FedCommManager, unmatchable_grace: float = 5.0,
+                 store_path: Optional[str] = None):
         self.comm = comm
         self.unmatchable_grace = unmatchable_grace
         self.workers: dict[int, dict] = {}
@@ -105,15 +110,53 @@ class MasterAgent:
         self.jobs: dict[str, _Job] = {}
         self.queue: list[str] = []
         self._lock = threading.Lock()
+        # durable state (reference: master/server_data_interface.py sqlite):
+        # every job transition is written through; restart replays the queue
+        self.store = None
+        if store_path is not None:
+            from .store import JobStore
+
+            self.store = JobStore(store_path)
+            self._recover()
         h = comm.register_message_receive_handler
         h(W2M_REGISTER, self._on_register)
         h(W2M_RESULT, self._on_result)
+
+    def _recover(self) -> None:
+        """Replay persisted jobs after a restart: terminal jobs keep their
+        results queryable; QUEUED and RUNNING jobs are re-queued (jobs are
+        assumed idempotent — a worker that kept running through the master's
+        death may double-execute, and the first terminal report wins).
+        Workers must re-register to rejoin the live registry (their comm
+        endpoints don't survive the restart); the persisted worker table is
+        history for diagnosis, not live state."""
+        import time
+
+        for row in self.store.load_jobs():
+            job = _Job(row["job_id"], row["spec"], status=row["status"],
+                       worker=row["worker"], result=row["result"],
+                       submitted=time.monotonic())
+            self.jobs[job.job_id] = job
+            if job.status in (STATUS_QUEUED, STATUS_RUNNING):
+                job.status = STATUS_QUEUED
+                job.worker = None
+                self.queue.append(job.job_id)
+                self.store.set_status(job.job_id, STATUS_QUEUED)
+                t = threading.Timer(self.unmatchable_grace + 0.1,
+                                    self._grace_check)
+                t.daemon = True
+                t.start()
+            else:
+                job.done.set()
 
     def _on_register(self, msg: Message) -> None:
         with self._lock:
             self.workers[msg.sender_id] = dict(msg.get(KEY_RESOURCES) or {})
             log.info("worker %s registered: %s", msg.sender_id,
                      self.workers[msg.sender_id])
+            if self.store is not None:
+                self.store.record_worker(msg.sender_id,
+                                         self.workers[msg.sender_id])
             self._dispatch()
 
     def submit(self, spec: dict) -> str:
@@ -126,6 +169,8 @@ class MasterAgent:
         with self._lock:
             self.jobs[job.job_id] = job
             self.queue.append(job.job_id)
+            if self.store is not None:
+                self.store.upsert_job(job.job_id, job.spec, job.status)
             self._dispatch()
             # a lone unmatchable job has no future event to re-trigger
             # dispatch; arm a timer to deliver the verdict after the grace
@@ -138,6 +183,17 @@ class MasterAgent:
     def _grace_check(self) -> None:
         with self._lock:
             self._dispatch()
+
+    def _persist(self, job: "_Job") -> None:
+        """Caller holds the lock. Best-effort write-through; a broken store
+        must not take the live scheduler down with it."""
+        if self.store is None:
+            return
+        try:
+            self.store.set_status(job.job_id, job.status, job.worker,
+                                  job.result)
+        except Exception:
+            log.exception("job store write failed for %s", job.job_id)
 
     def _dispatch(self) -> None:
         """Caller holds the lock. Assign queued jobs to free workers."""
@@ -156,6 +212,7 @@ class MasterAgent:
                     # past the registration grace AND nobody registered so
                     # far could ever run it
                     job.status = STATUS_UNMATCHABLE
+                    self._persist(job)
                     job.done.set()
                     log.warning("job %s unmatchable by any registered "
                                 "worker", jid)
@@ -173,11 +230,13 @@ class MasterAgent:
                 log.exception("dispatch of job %s failed", jid)
                 job.status = STATUS_FAILED
                 job.result = f"dispatch failed: {type(e).__name__}: {e}"
+                self._persist(job)
                 job.done.set()
                 continue
             job.status = STATUS_RUNNING
             job.worker = wid
             self.busy.add(wid)
+            self._persist(job)
         self.queue = remaining
 
     def _on_result(self, msg: Message) -> None:
@@ -189,6 +248,7 @@ class MasterAgent:
             job.status = msg.get(KEY_STATUS, STATUS_FINISHED)
             job.result = msg.get(KEY_RESULT)
             self.busy.discard(msg.sender_id)
+            self._persist(job)
             job.done.set()
             self._dispatch()
 
@@ -205,6 +265,8 @@ class MasterAgent:
 
     def stop(self) -> None:
         self.comm.stop()
+        if self.store is not None:
+            self.store.close()
 
 
 class WorkerAgent:
@@ -220,8 +282,14 @@ class WorkerAgent:
         self.runners: dict[str, Callable[[dict], Any]] = {
             "simulation": self._run_simulation,
             "python": self._run_python,
+            "serve": self._run_serve,
+            "serve_stop": self._run_serve_stop,
         }
         self._py_registry: dict[str, Callable] = {}
+        # replica_id -> FedMLInferenceRunner started by "serve" jobs
+        # (reference: model_scheduler/device_model_deployment.py keeps the
+        # per-device containers; here replicas are in-process HTTP servers)
+        self.active_servers: dict[str, Any] = {}
         comm.register_message_receive_handler(M2W_ASSIGN, self._on_assign)
 
     @staticmethod
@@ -258,6 +326,26 @@ class WorkerAgent:
             raise ValueError(
                 f"no registered python job {spec.get('entry')!r}")
         return fn(spec.get("args", {}))
+
+    def _run_serve(self, spec: dict):
+        """Start an inference replica on this worker; the job result is the
+        replica's endpoint. The HTTP server keeps running after the job
+        completes — deployment lifetime is managed by serve_stop (reference:
+        model_scheduler/device_model_deployment.py start_deployment)."""
+        from ..serving.scheduler import start_replica
+
+        replica_id, runner = start_replica(spec)
+        self.active_servers[replica_id] = runner
+        return {"replica_id": replica_id, "host": "127.0.0.1",
+                "port": runner.port, "worker_id": self.worker_id}
+
+    def _run_serve_stop(self, spec: dict):
+        rid = spec.get("replica_id", "")
+        runner = self.active_servers.pop(rid, None)
+        if runner is None:
+            return {"stopped": False, "replica_id": rid}
+        runner.stop()
+        return {"stopped": True, "replica_id": rid}
 
     def _on_assign(self, msg: Message) -> None:
         jid = msg.get(KEY_JOB_ID)

@@ -1,0 +1,327 @@
+"""Model-serving scheduler — deploy FSM, inference gateway, autoscaler.
+
+(reference: computing/scheduler/model_scheduler/ ~8k LoC —
+device_model_deployment.py:37 start_deployment packages a model and brings
+up per-device inference containers with readiness polling;
+device_model_inference.py:32-143 is the gateway that routes /predict to
+ready devices; autoscaling rides the SaaS. Here the same three roles are
+local-first over fedml_tpu's own scheduler agents:)
+
+- Deployment.deploy(): package (model spec + params/checkpoint) → submit one
+  "serve" job per replica through the MasterAgent → workers start in-process
+  HTTP replicas (serving/inference_runner.py) → poll /ready until live.
+  FSM per replica: DISPATCHED → STARTING → READY | DEAD.
+- InferenceGateway: HTTP /predict facade; round-robins over READY replicas,
+  retries the next replica when one dies mid-request (and marks it DEAD so
+  the autoscaler replaces it). /ready reports deployment health.
+- Autoscaler: queue-depth scaling — the gateway tracks in-flight requests;
+  above high_water x replicas it submits another serve job, below low_water
+  it retires one (min/max bounds). The same policy shape as the reference's
+  target-concurrency autoscaler, with XLA-friendly in-process replicas
+  instead of docker containers.
+
+TPU note: replicas on one host share the chip; scale-out here exists for
+fault tolerance and request pipelining (host-side pre/post-processing
+overlaps device steps). Cross-host replicas ride the same job spec over a
+broker/grpc comm backend unchanged.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+R_DISPATCHED = "DISPATCHED"
+R_READY = "READY"
+R_DEAD = "DEAD"
+
+
+def start_replica(spec: dict):
+    """Worker-side: build a predictor from a deployment spec and serve it.
+    Spec sources (first match wins):
+      - "checkpoint_dir": orbax checkpoint from utils/checkpoint.py
+      - "params": inline pytree of ndarrays (rides the tensor wire format)
+    plus "model"/"num_classes"/"input_shape"/"model_args" to rebuild the
+    apply_fn (reference: start_deployment's model-package unpack)."""
+    import jax.numpy as jnp
+
+    from ..models import hub as model_hub
+    from .inference_runner import FedMLInferenceRunner
+    from .predictor import JaxPredictor
+
+    model = model_hub.create(spec["model"], int(spec.get("num_classes", 10)),
+                             **dict(spec.get("model_args", {})))
+    apply_fn = model_hub.mixed_precision_apply(
+        model.apply, spec.get("compute_dtype", "float32"))
+    if spec.get("checkpoint_dir"):
+        import jax
+
+        from ..algorithms import build_algorithm
+        from ..config import TrainArgs
+        from ..utils.checkpoint import restore_checkpoint
+
+        # the saved server-state STRUCTURE depends on the algorithm that
+        # trained it; rebuild the same template the Simulator used
+        init = model_hub.init_params(
+            model, tuple(spec["input_shape"]), jax.random.key(0))
+        alg = build_algorithm(spec.get("federated_optimizer", "FedAvg"),
+                              apply_fn, TrainArgs(), 1, 1)
+        _r, server, _c, _h, _hist = restore_checkpoint(
+            spec["checkpoint_dir"], alg.server_init(init))
+        params = server.params
+    else:
+        params = jnp.asarray(spec["params"]) if not isinstance(
+            spec["params"], dict) else spec["params"]
+    pred = JaxPredictor(apply_fn, params)
+    runner = FedMLInferenceRunner(pred, port=int(spec.get("port", 0)))
+    runner.start()
+    return uuid.uuid4().hex[:10], runner
+
+
+class _Replica:
+    def __init__(self, job_id: str):
+        self.job_id = job_id
+        self.state = R_DISPATCHED
+        self.replica_id: Optional[str] = None
+        self.endpoint: Optional[str] = None
+        self.worker_id: Optional[int] = None
+
+
+class Deployment:
+    """Deploy FSM over a MasterAgent (reference:
+    device_model_deployment.py:37 start_deployment)."""
+
+    def __init__(self, master, serve_spec: dict, min_replicas: int = 1,
+                 max_replicas: int = 4):
+        self.master = master
+        self.spec = dict(serve_spec)
+        self.spec["type"] = "serve"
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.replicas: list[_Replica] = []
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    # ------------------------------------------------------------ deploy
+    def deploy(self, n_replicas: Optional[int] = None,
+               timeout: float = 60.0) -> "Deployment":
+        n = n_replicas if n_replicas is not None else self.min_replicas
+        for _ in range(n):
+            self._dispatch_one(timeout)
+        self.wait_ready(n, timeout)
+        return self
+
+    def _dispatch_one(self, timeout: float = 60.0) -> _Replica:
+        jid = self.master.submit(dict(self.spec))
+        rep = _Replica(jid)
+        with self._lock:
+            self.replicas.append(rep)
+        threading.Thread(target=self._track, args=(rep, timeout),
+                         daemon=True).start()
+        return rep
+
+    def _track(self, rep: _Replica, timeout: float = 60.0) -> None:
+        """DISPATCHED -> (job result with endpoint) -> poll /ready -> READY."""
+        job = self.master.wait(rep.job_id, timeout=timeout)
+        if job.status != "FINISHED" or not isinstance(job.result, dict):
+            rep.state = R_DEAD
+            log.warning("replica job %s failed: %s", rep.job_id, job.result)
+            return
+        rep.replica_id = job.result["replica_id"]
+        rep.worker_id = job.result.get("worker_id")
+        rep.endpoint = f"http://{job.result['host']}:{job.result['port']}"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(rep.endpoint + "/ready",
+                                            timeout=2) as r:
+                    if r.status == 200:
+                        rep.state = R_READY
+                        return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.05)
+        rep.state = R_DEAD
+
+    def wait_ready(self, n: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.ready_replicas()) >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def ready_replicas(self) -> list[_Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.state == R_READY]
+
+    # ------------------------------------------------------------ routing
+    def pick(self) -> Optional[_Replica]:
+        ready = self.ready_replicas()
+        if not ready:
+            return None
+        with self._lock:
+            self._rr += 1
+            return ready[self._rr % len(ready)]
+
+    def mark_dead(self, rep: _Replica) -> None:
+        rep.state = R_DEAD
+
+    # ------------------------------------------------------------ scaling
+    def scale_up(self) -> Optional[_Replica]:
+        with self._lock:
+            live = [r for r in self.replicas if r.state != R_DEAD]
+            if len(live) >= self.max_replicas:
+                return None
+        log.info("autoscale: +1 replica")
+        return self._dispatch_one()
+
+    def scale_down(self) -> bool:
+        ready = self.ready_replicas()
+        if len(ready) <= self.min_replicas:
+            return False
+        rep = ready[-1]
+        rep.state = R_DEAD  # drains immediately: pick() skips it
+        log.info("autoscale: -1 replica (%s)", rep.replica_id)
+        # pin the stop job to the worker hosting the replica — any other
+        # worker's active_servers has no such replica_id and the HTTP
+        # server would leak for the life of the right worker's process
+        req = dict(self.spec.get("requirements", {}))
+        req["worker_id"] = rep.worker_id
+        self.master.submit({"type": "serve_stop",
+                            "replica_id": rep.replica_id,
+                            "requirements": req})
+        return True
+
+    def reap_and_heal(self) -> None:
+        """Replace dead replicas down to min_replicas (the reference gateway
+        reports unhealthy endpoints back to the deployment FSM)."""
+        with self._lock:
+            live = [r for r in self.replicas
+                    if r.state in (R_READY, R_DISPATCHED)]
+            need = self.min_replicas - len(live)
+        for _ in range(max(0, need)):
+            self._dispatch_one()
+
+
+class InferenceGateway:
+    """HTTP /predict facade with failover routing + queue-depth autoscaling
+    (reference: device_model_inference.py:32-143)."""
+
+    def __init__(self, deployment: Deployment, host: str = "127.0.0.1",
+                 port: int = 0, high_water: float = 2.0,
+                 low_water: float = 0.25, scale_interval: float = 0.5):
+        self.dep = deployment
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.high_water = high_water
+        self.low_water = low_water
+        self.scale_interval = scale_interval
+        self._stop = threading.Event()
+        gateway = self
+
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("gateway: " + fmt, *args)
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    n = len(gateway.dep.ready_replicas())
+                    self._send(200 if n else 503,
+                               {"ready_replicas": n})
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with gateway._inflight_lock:
+                    gateway.inflight += 1
+                try:
+                    code, payload = gateway.forward(body)
+                    self._send(code, payload)
+                finally:
+                    with gateway._inflight_lock:
+                        gateway.inflight -= 1
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._scaler: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- routing
+    def forward(self, body: bytes, tries: int = 3) -> tuple[int, dict]:
+        """Round-robin with failover: a replica that errors at the transport
+        level is marked DEAD and the request retries elsewhere."""
+        for _ in range(tries):
+            rep = self.dep.pick()
+            if rep is None:
+                return 503, {"error": "no ready replicas"}
+            req = urllib.request.Request(
+                rep.endpoint + "/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                # the replica is alive and rejected the request (bad input):
+                # surface the error, don't kill the replica
+                try:
+                    return e.code, json.loads(e.read() or b"{}")
+                except (json.JSONDecodeError, OSError):
+                    return e.code, {"error": f"replica returned {e.code}"}
+            except (urllib.error.URLError, OSError, json.JSONDecodeError):
+                log.warning("replica %s unreachable; rerouting",
+                            rep.replica_id)
+                self.dep.mark_dead(rep)
+                self.dep.reap_and_heal()
+        return 502, {"error": "all replicas failed"}
+
+    # ------------------------------------------------------- autoscaling
+    def _scale_loop(self) -> None:
+        while not self._stop.wait(self.scale_interval):
+            ready = len(self.dep.ready_replicas())
+            with self._inflight_lock:
+                load = self.inflight
+            if ready == 0:
+                self.dep.reap_and_heal()
+            elif load / ready > self.high_water:
+                self.dep.scale_up()
+            elif load / ready < self.low_water:
+                self.dep.scale_down()
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "InferenceGateway":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self._scaler = threading.Thread(target=self._scale_loop, daemon=True)
+        self._scaler.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

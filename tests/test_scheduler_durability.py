@@ -1,0 +1,218 @@
+"""Agent durability (scheduler/store.py) + model-serving scheduler
+(serving/scheduler.py): deploy FSM, gateway failover, autoscaling.
+
+(reference parity: master/server_data_interface.py sqlite persistence +
+server_runner restart recovery; model_scheduler/device_model_deployment.py
+deploy + device_model_inference.py gateway.)
+"""
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import FedCommManager
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.scheduler import (
+    STATUS_FINISHED, MasterAgent, WorkerAgent,
+)
+from fedml_tpu.scheduler.store import JobStore
+
+
+def _post(url: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# --------------------------------------------------------------- job store
+def test_store_roundtrips_jobs_and_tensor_results(tmp_path):
+    s = JobStore(str(tmp_path / "jobs.db"))
+    spec = {"type": "python", "entry": "f", "args": {"x": 1}}
+    s.upsert_job("j1", spec, "QUEUED")
+    s.set_status("j1", "FINISHED", worker=3,
+                 result={"acc": 0.9, "w": np.arange(4, dtype=np.float32)})
+    s.record_worker(3, {"devices": 8, "tags": ["tpu"]})
+    s.close()
+
+    s2 = JobStore(str(tmp_path / "jobs.db"))
+    jobs = s2.load_jobs()
+    assert len(jobs) == 1 and jobs[0]["job_id"] == "j1"
+    assert jobs[0]["spec"] == spec
+    assert jobs[0]["status"] == "FINISHED" and jobs[0]["worker"] == 3
+    np.testing.assert_array_equal(jobs[0]["result"]["w"],
+                                  np.arange(4, dtype=np.float32))
+    assert s2.load_workers()[3]["devices"] == 8
+    s2.close()
+
+
+def test_master_restart_resumes_queued_job(tmp_path):
+    """Kill the master with a job still queued (no worker yet); the
+    restarted master must re-dispatch it once a worker registers
+    (reference: server_runner.py:489 restart recovery)."""
+    db = str(tmp_path / "master.db")
+    run1 = f"dur-{uuid.uuid4().hex[:6]}"
+    m1 = MasterAgent(FedCommManager(LoopbackTransport(0, run1), 0),
+                     store_path=db, unmatchable_grace=30)
+    m1.run()
+    jid = m1.submit({"type": "python", "entry": "noop",
+                     "requirements": {}})
+    time.sleep(0.2)
+    m1.stop()          # dies with the job QUEUED, nothing registered
+    release_router(run1)
+
+    run2 = f"dur-{uuid.uuid4().hex[:6]}"
+    m2 = MasterAgent(FedCommManager(LoopbackTransport(0, run2), 0),
+                     store_path=db, unmatchable_grace=30)
+    assert m2.status(jid) == "QUEUED"     # replayed from the store
+    w = WorkerAgent(FedCommManager(LoopbackTransport(1, run2), 1), 1,
+                    resources={"devices": 1, "mem_mb": 64, "tags": []})
+    w.register_python_job("noop", lambda args: {"ok": True})
+    m2.run()
+    w.run()
+    w.announce()
+    job = m2.wait(jid, timeout=30)
+    assert job.status == STATUS_FINISHED and job.result == {"ok": True}
+    # terminal state survives another restart
+    m2.stop()
+    w.stop()
+    release_router(run2)
+    m3 = MasterAgent(FedCommManager(LoopbackTransport(0, "dur-x"), 0),
+                     store_path=db)
+    assert m3.status(jid) == STATUS_FINISHED
+    assert m3.wait(jid, timeout=1).result == {"ok": True}
+    m3.stop()
+    release_router("dur-x")
+
+
+def test_master_restart_requeues_running_job(tmp_path):
+    """A job RUNNING at crash time is re-queued on restart (idempotent-job
+    contract) and completes on the new incarnation's worker."""
+    db = str(tmp_path / "master2.db")
+    run1 = f"dur-{uuid.uuid4().hex[:6]}"
+    m1 = MasterAgent(FedCommManager(LoopbackTransport(0, run1), 0),
+                     store_path=db)
+    w1 = WorkerAgent(FedCommManager(LoopbackTransport(1, run1), 1), 1,
+                     resources={"devices": 1, "mem_mb": 64, "tags": []})
+    hang = threading.Event()
+    w1.register_python_job("slow", lambda args: hang.wait(60))
+    m1.run(); w1.run(); w1.announce()
+    jid = m1.submit({"type": "python", "entry": "slow", "requirements": {}})
+    deadline = time.monotonic() + 10
+    while m1.status(jid) != "RUNNING" and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert m1.status(jid) == "RUNNING"
+    m1.stop(); w1.stop()        # master dies mid-job
+    release_router(run1)
+    hang.set()
+
+    run2 = f"dur-{uuid.uuid4().hex[:6]}"
+    m2 = MasterAgent(FedCommManager(LoopbackTransport(0, run2), 0),
+                     store_path=db)
+    assert m2.status(jid) == "QUEUED"
+    w2 = WorkerAgent(FedCommManager(LoopbackTransport(1, run2), 1), 1,
+                     resources={"devices": 1, "mem_mb": 64, "tags": []})
+    w2.register_python_job("slow", lambda args: {"done": True})
+    m2.run(); w2.run(); w2.announce()
+    job = m2.wait(jid, timeout=30)
+    assert job.status == STATUS_FINISHED and job.result == {"done": True}
+    m2.stop(); w2.stop()
+    release_router(run2)
+
+
+# ------------------------------------------------- model-serving scheduler
+def _serving_cluster(n_workers=2):
+    from fedml_tpu.serving.scheduler import Deployment
+
+    run_id = f"deploy-{uuid.uuid4().hex[:6]}"
+    master = MasterAgent(FedCommManager(LoopbackTransport(0, run_id), 0))
+    workers = []
+    for wid in range(1, n_workers + 1):
+        w = WorkerAgent(FedCommManager(LoopbackTransport(wid, run_id), wid),
+                        wid, resources={"devices": 1, "mem_mb": 64,
+                                        "tags": ["serve"]})
+        workers.append(w)
+    master.run()
+    for w in workers:
+        w.run(); w.announce()
+
+    rng = np.random.RandomState(0)
+    params = {"Dense_0": {"kernel": rng.randn(4, 3).astype(np.float32),
+                          "bias": np.zeros(3, np.float32)}}
+    spec = {"model": "lr", "num_classes": 3, "params": params,
+            "requirements": {"tags": ["serve"]}}
+    dep = Deployment(master, spec, min_replicas=2, max_replicas=3)
+    return run_id, master, workers, dep
+
+
+def test_deploy_gateway_failover_e2e():
+    """VERDICT #4 'done' bar: deploy -> gateway /predict round-trips ->
+    kill a worker's replica -> traffic re-routes to the survivor."""
+    from fedml_tpu.serving.scheduler import InferenceGateway
+
+    run_id, master, workers, dep = _serving_cluster(2)
+    try:
+        assert dep.deploy(2, timeout=60).ready_replicas()
+        gw = InferenceGateway(dep, scale_interval=30).start()
+        url = f"http://127.0.0.1:{gw.port}"
+        x = [[0.1, 0.2, 0.3, 0.4]]
+        out = _post(url + "/predict", {"inputs": x})
+        assert "predictions" in out, out
+
+        # kill one replica's HTTP server out from under the gateway
+        victim = None
+        for w in workers:
+            if w.active_servers:
+                rid, runner = next(iter(w.active_servers.items()))
+                runner.stop()
+                victim = rid
+                break
+        assert victim is not None
+        # every subsequent request must still succeed via the survivor
+        for _ in range(4):
+            out = _post(url + "/predict", {"inputs": x})
+            assert "predictions" in out, out
+        assert any(r.state == "DEAD" and r.replica_id == victim
+                   for r in dep.replicas)
+        gw.stop()
+    finally:
+        master.stop()
+        for w in workers:
+            w.stop()
+        release_router(run_id)
+
+
+def test_autoscaler_scales_up_under_load():
+    from fedml_tpu.serving.scheduler import InferenceGateway
+
+    run_id, master, workers, dep = _serving_cluster(3)
+    try:
+        dep.min_replicas, dep.max_replicas = 1, 3
+        assert dep.deploy(1, timeout=60).ready_replicas()
+        gw = InferenceGateway(dep, high_water=0.5, low_water=-1.0,
+                              scale_interval=0.1).start()
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        stop = time.monotonic() + 8
+        threads = [threading.Thread(
+            target=lambda: [_post(url, {"inputs": [[0.0] * 4]})
+                            for _ in range(50) if time.monotonic() < stop],
+            daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(dep.ready_replicas()) >= 2:
+                break
+            time.sleep(0.1)
+        assert len(dep.ready_replicas()) >= 2, "autoscaler never scaled up"
+        gw.stop()
+    finally:
+        master.stop()
+        for w in workers:
+            w.stop()
+        release_router(run_id)
