@@ -162,15 +162,21 @@ def cmd_build(args) -> int:
         for fn in sorted(files):
             p = os.path.join(root, fn)
             rel = os.path.relpath(p, src)
+            if rel == "fedml_manifest.json":
+                continue  # superseded by the generated manifest below
             with open(p, "rb") as f:
                 manifest["files"][rel] = hashlib.sha256(f.read()).hexdigest()
-    # the manifest goes into the tarball from memory — writing it into the
-    # user's source dir could clobber a pre-existing fedml_manifest.json
+    # the manifest goes into the tarball from memory (never written into the
+    # user's source dir); a pre-existing fedml_manifest.json — e.g. from an
+    # unpacked previous package — is excluded so the archive holds exactly
+    # one, self-consistent manifest member
     import io
 
     man_bytes = json.dumps(manifest, indent=2).encode()
     with tarfile.open(out, "w:gz") as tar:
-        tar.add(src, arcname=name)
+        tar.add(src, arcname=name,
+                filter=lambda ti: None
+                if ti.name == f"{name}/fedml_manifest.json" else ti)
         info = tarfile.TarInfo(f"{name}/fedml_manifest.json")
         info.size = len(man_bytes)
         info.mtime = int(manifest["created"])

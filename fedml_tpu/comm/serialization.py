@@ -88,11 +88,13 @@ def encode(tree: Pytree) -> bytes:
     head = json.dumps({"tree": header, "sizes": sizes}).encode()
     from ..native import crc32c
 
-    body = b"".join([struct.pack("<I", len(head)), head] + buffers)
-    crc = crc32c(_MAGIC_CRC + body)
+    frame = b"".join([_MAGIC_CRC, struct.pack("<I", len(head)), head]
+                     + buffers)
+    crc = crc32c(frame)
     if crc is None:
-        return _MAGIC + body
-    return _MAGIC_CRC + body + _CRC_TAG + struct.pack("<I", crc)
+        # no native lib: emit trailer-less FT01 (same body, different magic)
+        return _MAGIC + frame[4:]
+    return frame + _CRC_TAG + struct.pack("<I", crc)
 
 
 def decode(data: bytes | memoryview) -> Pytree:

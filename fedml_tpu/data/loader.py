@@ -34,7 +34,17 @@ DATASET_SHAPES = {
     "synthetic": ((60,), 10),
     "digits": ((8, 8, 1), 10),
     "shakespeare": ((80,), 80),   # 80-char contexts, char-vocab classes
+    # TFF-format h5 federated sets (data/tff_h5.py; reference:
+    # data/{fed_cifar100,fed_shakespeare,stackoverflow_*}/data_loader.py)
+    "fed_cifar100": ((32, 32, 3), 100),
+    "fed_shakespeare": ((80,), 90),          # CHAR_VOCAB + pad/bos/eos/oov
+    "stackoverflow_nwp": ((20,), 10004),     # 10k words + 4 special ids
+    "stackoverflow_lr": ((10000,), 500),     # BoW in, 500 multi-hot tags out
 }
+
+# token-sequence NWP tasks: synthetic fallback generates [N, T] int x with
+# per-position next-token targets instead of Gaussian feature vectors
+_TOKEN_TASKS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 
 
 def synthetic_classification(
@@ -77,7 +87,11 @@ def _synthetic_for(name: str, cfg: Config) -> FedDataset:
     shape, num_classes = DATASET_SHAPES.get(name, DATASET_SHAPES["synthetic"])
     per_client = int(cfg.data_args.extra.get("synthetic_samples_per_client", 120))
     n = max(cfg.train_args.client_num_in_total * per_client, 500)
-    if name == "shakespeare":
+    if name == "stackoverflow_lr":
+        from .tff_h5 import synthetic_multilabel
+
+        return synthetic_multilabel(cfg)
+    if name in _TOKEN_TASKS:
         # token task: sequences where next char = (char + 1) mod V —
         # learnable by any sequence model; targets per position (NWP shape)
         rng = np.random.RandomState(cfg.common_args.random_seed)
@@ -331,6 +345,13 @@ def _make_named_loader(name: str):
                 return ds
         if name == "shakespeare":
             ds = _leaf_shakespeare(cache, cfg)
+            if ds is not None:
+                return ds
+        if name in ("fed_cifar100", "fed_shakespeare", "stackoverflow_nwp",
+                    "stackoverflow_lr"):
+            from . import tff_h5
+
+            ds = getattr(tff_h5, name)(cache, cfg)
             if ds is not None:
                 return ds
         ds = _npz_dataset(name, cache, cfg)

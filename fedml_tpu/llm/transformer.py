@@ -92,13 +92,19 @@ class TransformerLM(nn.Module):
     n_heads: int = 4
     d_ff: int = 256
     attn_fn: Optional[Callable] = None
+    # gradient checkpointing per block: activations are recomputed in the
+    # backward instead of stored, trading ~1 extra forward of FLOPs for
+    # O(layers x B x T x D) -> O(B x T x D) activation memory — what lets a
+    # >=1B-param base train at T=2048 on one chip (SURVEY §5.7 remat note)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, pos_offset=0):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.n_layers):
-            x = Block(self.n_heads, self.d_ff, self.attn_fn,
-                      name=f"block_{i}")(x, pos)
+            x = block_cls(self.n_heads, self.d_ff, self.attn_fn,
+                          name=f"block_{i}")(x, pos)
         x = RMSNorm(name="final_norm")(x)
         return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

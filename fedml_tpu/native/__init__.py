@@ -81,6 +81,11 @@ def _load() -> Optional[ctypes.CDLL]:
                                      i64p, ctypes.c_int64, ctypes.c_int64,
                                      ctypes.c_double]
         lib.lr_sgd_train.restype = ctypes.c_double
+        lib.cnn_sgd_train.argtypes = ([f32p, i32p]
+                                      + [ctypes.c_int64] * 8  # n,H,W,Ci,C1,C2,Dh,K
+                                      + [f32p, i64p, ctypes.c_int64,
+                                         ctypes.c_int64, ctypes.c_double])
+        lib.cnn_sgd_train.restype = ctypes.c_double
         _lib = lib
         return _lib
 
@@ -158,4 +163,67 @@ class NativeLRTrainer:
         mean_loss = lib.lr_sgd_train(
             self.x, self.y, n, d, self.k, out,
             np.ascontiguousarray(perm), self.epochs * nb, bs, self.lr)
+        return out, self.n_samples, {"train_loss": float(mean_loss)}
+
+
+class NativeCNNTrainer:
+    """MobileNN-analog CNN edge trainer: the framework's 2-conv CNN
+    (models/hub.py CNN) trained entirely in C++ — conv/pool/dense forward
+    AND backward handwritten, no jax (reference:
+    android/fedmlsdk/MobileNN/src/train/FedMLMNNTrainer.cpp:3-80 trains
+    mnist/cifar CNNs on-device). Params cross the boundary as the flat
+    float32 vector in jax.tree.leaves order of the flax CNN, so a global
+    model from the TPU server trains here unchanged and aggregates back.
+
+    x: [n, H, W, Cin] float32 (H, W divisible by 4); y: [n] int labels."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, num_classes: int,
+                 c1: int = 32, c2: int = 64, hidden: int = 128,
+                 lr: float = 0.1, batch_size: int = 16, epochs: int = 1,
+                 seed: int = 0):
+        if not available():
+            raise RuntimeError("native library unavailable (no g++?) — use "
+                               "the jax SiloTrainer instead")
+        self.x = np.ascontiguousarray(np.asarray(x, np.float32))
+        if self.x.ndim != 4:
+            raise ValueError(f"x must be [n, H, W, Cin]; got {self.x.shape}")
+        _n, h, w, _ci = self.x.shape
+        if h % 4 or w % 4:
+            raise ValueError(f"H, W must be divisible by 4 (two maxpool2 "
+                             f"stages); got ({h}, {w})")
+        self.y = np.ascontiguousarray(np.asarray(y, np.int32))
+        self.k = int(num_classes)
+        if self.y.size and (self.y.min() < 0 or self.y.max() >= self.k):
+            raise ValueError(
+                f"labels must be in [0, {self.k}); got range "
+                f"[{self.y.min()}, {self.y.max()}]")
+        self.c1, self.c2, self.hidden = int(c1), int(c2), int(hidden)
+        self.lr, self.bs, self.epochs, self.seed = lr, batch_size, epochs, seed
+        self.n_samples = int(self.x.shape[0])
+
+    @property
+    def n_params(self) -> int:
+        _n, h, w, ci = self.x.shape
+        f = (h // 4) * (w // 4) * self.c2
+        return (self.c1 + 9 * ci * self.c1 + self.c2 + 9 * self.c1 * self.c2
+                + self.hidden + f * self.hidden + self.k
+                + self.hidden * self.k)
+
+    def train(self, params_flat: np.ndarray, round_idx: int):
+        lib = _load()
+        n, h, w, ci = self.x.shape
+        out = np.ascontiguousarray(np.asarray(params_flat, np.float32).copy())
+        if out.size != self.n_params:
+            raise ValueError(f"params size {out.size} != expected "
+                             f"{self.n_params} for this architecture")
+        bs = min(self.bs, n)
+        nb = n // bs
+        rs = np.random.RandomState(self.seed * 100003 + round_idx)
+        perm = np.concatenate([
+            rs.permutation(n)[: nb * bs] for _ in range(self.epochs)
+        ]).astype(np.int64)
+        mean_loss = lib.cnn_sgd_train(
+            self.x, self.y, n, h, w, ci, self.c1, self.c2, self.hidden,
+            self.k, out, np.ascontiguousarray(perm), self.epochs * nb, bs,
+            self.lr)
         return out, self.n_samples, {"train_loss": float(mean_loss)}

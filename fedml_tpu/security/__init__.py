@@ -116,11 +116,32 @@ class FedAttacker:
                     self.spec.get("target_class"),
                 )),
             )
-        if self.type in ("backdoor", "edge_case_backdoor"):
+        if self.type == "backdoor":
             target = int(self.spec.get("target_class", 0))
             return atk.poison_clients_data(
                 data, cids, lambda x, y: atk.backdoor_trigger(x, y, target)
             )
+        if self.type == "edge_case_backdoor":
+            # Attack of the Tails (reference: edge_case_backdoor_attack.py):
+            # malicious clients swap a fraction of their data for low-density
+            # edge-case examples labeled with the target class — no pixel
+            # trigger, so norm/trigger-based defenses have less to see
+            from ..data.poison import edge_case_pool, replace_with_edge_cases
+
+            target = int(self.spec.get("target_class", 0))
+            source = int(self.spec.get("source_class", num_classes - 1))
+            frac = float(self.spec.get("sample_frac", 0.5))
+            tail = float(self.spec.get("tail_frac", 0.1))
+            real = data["mask"].reshape(-1) > 0
+            pool = edge_case_pool(
+                data["x"].reshape((-1,) + data["x"].shape[2:])[real],
+                data["y"].reshape(-1)[real], source, tail)
+            out = {k: np.array(v) for k, v in data.items()}
+            for i, c in enumerate(cids):
+                out["x"][c], out["y"][c] = replace_with_edge_cases(
+                    out["x"][c], out["y"][c], out["mask"][c], pool,
+                    target, frac, seed=1000 + i)
+            return out
         return data
 
 

@@ -34,6 +34,7 @@ def _cfg(**train_over):
     })
 
 
+@pytest.mark.slow
 def test_kill_and_resume_is_identical(tmp_path):
     ckpt = str(tmp_path / "ckpt")
 

@@ -6,6 +6,7 @@ import uuid
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import fedml_tpu
 from fedml_tpu.comm import FedCommManager
@@ -91,6 +92,7 @@ class _DieAfterRound0:
         return self.inner.train(params, r)
 
 
+@pytest.mark.slow
 def test_cross_device_flaky_device_dropped_from_registry():
     def flaky(did, tr):
         return _DieAfterRound0(tr) if did == 3 else tr

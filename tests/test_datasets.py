@@ -4,6 +4,7 @@ import json
 import pickle
 
 import numpy as np
+import pytest
 
 import fedml_tpu
 from fedml_tpu.data import loader as dl
@@ -77,6 +78,7 @@ def test_shakespeare_leaf_json(tmp_path):
     assert (tgt[:-1] == row[1:]).all()
 
 
+@pytest.mark.slow
 def test_shakespeare_synthetic_fallback_trains_rnn(tmp_path):
     """No files -> int-token synthetic NWP data that a sequence model can
     actually learn through the public API."""

@@ -3,6 +3,7 @@ simulation/mpi/fednas/ + model/cv/darts/)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms.builtin import make_fedavg
 from fedml_tpu.config import TrainArgs
@@ -29,6 +30,7 @@ def test_kd_kl_properties():
     assert float(kd_kl(a, b, 3.0)) > float(kd_kl(b, b, 3.0))
 
 
+@pytest.mark.slow
 def test_fedgkt_alternating_transfer_converges():
     data = _image_task()
     runner = FedGKTRunner(data, num_classes=3, lr=0.02, batch_size=16,
@@ -58,6 +60,7 @@ def test_darts_forward_and_alphas():
     assert set(arch.values()) <= {"conv3", "conv1", "skip", "avgpool"}
 
 
+@pytest.mark.slow
 def test_fednas_federates_weights_and_alphas():
     """FedAvg over the DARTS supernet trains weights AND moves the
     architecture parameters — the FedNAS semantics."""

@@ -61,6 +61,7 @@ def test_ulysses_attention_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match_dense():
     q, k, v = _qkv(2, t=16)
     mesh = _seq_mesh(4)
@@ -143,6 +144,7 @@ def test_federated_lora_flat_trains_adapters_only():
         lora_init(jax.random.key(1), base, rank=4).keys())
 
 
+@pytest.mark.slow
 def test_fedllm_seq_round_matches_flat():
     """(silos=2, seq=4) ring-attention round == flat engine round, exactly:
     same rngs, same batch composition, sum-CE/psum == batch-mean grads."""

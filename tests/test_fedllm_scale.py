@@ -1,0 +1,71 @@
+"""Scaled FedLLM composition (llm/scale.py): TP-sharded frozen base x
+replicated LoRA x ring attention island x remat, one jit over a
+{dp, tp, seq} mesh — VERDICT round-2 item 3.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.llm.lora import lora_apply_fn, lora_merge
+from fedml_tpu.llm.scale import (
+    build_scaled_fedllm, restore_base_sharded, save_base_sharded,
+)
+from fedml_tpu.llm.transformer import TransformerLM
+from fedml_tpu.parallel.mesh import make_mesh
+
+VOCAB, D, L, H, FF, T = 64, 32, 2, 4, 64, 16
+
+
+def _build(mesh, seq_axis="seq"):
+    return build_scaled_fedllm(
+        TransformerLM, mesh, vocab_size=VOCAB, d_model=D, n_layers=L,
+        n_heads=H, d_ff=FF, t_len=T, rank=4, lr=0.5, seq_axis=seq_axis,
+        compute_dtype="float32")
+
+
+def test_scaled_step_trains_and_matches_dense():
+    mesh = make_mesh({"dp": 2, "tp": 2, "seq": 2})
+    model, base, adapters, step = _build(mesh)
+    rs = np.random.RandomState(0)
+    seqs = (rs.randint(0, VOCAB, (4, 1)) + np.arange(T + 1)) % VOCAB
+    x = jnp.asarray(seqs[:, :-1], jnp.int32)
+    y = jnp.asarray(seqs[:, 1:], jnp.int32)
+
+    # reference loss: same base + adapters, DENSE attention, no mesh
+    dense_model = TransformerLM(vocab_size=VOCAB, d_model=D, n_layers=L,
+                                n_heads=H, d_ff=FF)
+    ref_apply = lora_apply_fn(dense_model.apply, jax.device_get(base))
+    logits = ref_apply({"params": adapters}, x)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ref_loss = -jnp.take_along_axis(logp, y[..., None], -1).mean()
+
+    ad1, loss1 = step(adapters, x, y)
+    assert np.isfinite(float(loss1))
+    # ring attention + TP sharding must reproduce the dense computation
+    assert abs(float(loss1) - float(ref_loss)) < 1e-3, (loss1, ref_loss)
+
+    losses = [float(loss1)]
+    ad = ad1
+    for _ in range(8):
+        ad, l = step(ad, x, y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses  # adapters actually learn
+    # the base stayed frozen and TP-sharded
+    assert any("tp" in str(s.spec) for s in
+               [l.sharding for l in jax.tree.leaves(base)][:8])
+
+
+def test_base_sharded_checkpoint_roundtrip(tmp_path):
+    mesh = make_mesh({"dp": 2, "tp": 2, "seq": 2})
+    _model, base, _ad, _step = _build(mesh)
+    save_base_sharded(str(tmp_path / "base"), base)
+    got = restore_base_sharded(str(tmp_path / "base"),
+                               jax.tree.map(np.asarray, base), mesh)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        base, got)
+    # restored leaves land TP-sharded, not replicated
+    flat = jax.tree.leaves(got)
+    assert any("tp" in str(l.sharding.spec) for l in flat)

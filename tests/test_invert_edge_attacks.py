@@ -1,0 +1,148 @@
+"""Invert-gradient (Geiping) + edge-case backdoor attacks — the two VERDICT
+round-2 gaps (reference: core/security/attack/invert_gradient_attack.py,
+edge_case_backdoor_attack.py).
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.security import attacks as atk
+from fedml_tpu.security.defenses import soteria_update_transform
+from fedml_tpu.simulation.simulator import Simulator
+
+
+class TinyImg(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+
+def _true_grads(model, params, x_true, label):
+    def loss(p):
+        logits = model.apply({"params": p}, x_true)
+        return -jax.nn.log_softmax(logits)[0, label]
+
+    return jax.grad(loss)(params)
+
+
+def _recon_err(x_rec, x_true):
+    return float(jnp.mean((x_rec - x_true) ** 2))
+
+
+def test_invert_gradient_reconstructs_and_degrades_under_defenses():
+    """Clean gradients -> good reconstruction; Soteria-pruned or DP-noised
+    gradients -> reconstruction quality drops by a clear margin (the
+    defense evidence VERDICT asks for)."""
+    shape = (6, 6, 1)
+    model = TinyImg()
+    rs = np.random.RandomState(0)
+    x_true = jnp.asarray(rs.rand(1, *shape), jnp.float32)
+    params = model.init(jax.random.key(0), x_true)["params"]
+    g = _true_grads(model, params, x_true, label=2)
+
+    run = lambda grads: atk.invert_gradient_attack(
+        model.apply, params, grads, shape, 4, jax.random.key(1),
+        steps=400, lr=0.05, tv_weight=1e-3)
+
+    x_rec, y_rec = run(g)
+    assert int(jnp.argmax(y_rec)) == 2          # iDLG label recovery
+    clean_err = _recon_err(x_rec, x_true)
+    base_err = _recon_err(jnp.full_like(x_true, 0.5), x_true)
+    assert clean_err < 0.5 * base_err, (clean_err, base_err)
+
+    # Soteria: prune 90% smallest coords of the flat gradient
+    flat, tree = jax.flatten_util.ravel_pytree(g)
+    g_sot = tree(soteria_update_transform(flat, prune_ratio=0.9))
+    sot_err = _recon_err(run(g_sot)[0], x_true)
+
+    # DP: gaussian noise at a magnitude comparable to the gradient scale
+    sigma = 0.5 * float(jnp.std(flat))
+    noise = sigma * jax.random.normal(jax.random.key(7), flat.shape)
+    g_dp = tree(flat + noise)
+    dp_err = _recon_err(run(g_dp)[0], x_true)
+
+    assert sot_err > 1.5 * clean_err, (sot_err, clean_err)
+    assert dp_err > 1.5 * clean_err, (dp_err, clean_err)
+
+
+def _train_digits(attack_spec=None, rounds=12):
+    sec = {}
+    if attack_spec is not None:
+        sec = {"security_args": {"enable_attack": True,
+                                 "attack_type": "edge_case_backdoor",
+                                 "attack_spec": attack_spec}}
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "digits", "partition_method": "hetero",
+                      "partition_alpha": 0.5},
+        "model_args": {"model": "mlp"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 6, "client_num_per_round": 6,
+            "comm_round": rounds, "epochs": 2, "batch_size": 32,
+            "learning_rate": 0.1,
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": "sp"},
+        **sec,
+    })
+    sim = Simulator(cfg)
+    sim.run(rounds)
+    return sim
+
+
+def _edge_success_rate(sim, source=7, target=1):
+    """Fraction of the test set's edge-case (tail) `source` samples the
+    model labels as `target` — the attack-success metric."""
+    from fedml_tpu.data.poison import edge_case_pool
+
+    ds = sim.dataset
+    pool = edge_case_pool(ds.x_test, ds.y_test, source, tail_frac=0.4)
+    logits = sim.apply_fn({"params": sim.server_state.params}, pool)
+    pred = np.asarray(jnp.argmax(logits, -1))
+    return float((pred == target).mean())
+
+
+@pytest.mark.slow
+def test_edge_case_backdoor_flips_tail_predictions():
+    spec = {"poisoned_client_ids": [0, 1], "source_class": 7,
+            "target_class": 1, "sample_frac": 0.5, "tail_frac": 0.5}
+    clean = _train_digits(None)
+    poisoned = _train_digits(spec)
+    sr_clean = _edge_success_rate(clean)
+    sr_poisoned = _edge_success_rate(poisoned)
+    # clean test accuracy barely moves (stealth), but tail-source samples
+    # flip to the attacker's target far more often (CPU-mesh-tuned: clean
+    # acc 0.925 -> poisoned 0.836, edge success 0.0 -> 1.0)
+    assert poisoned.evaluate()["test_acc"] > 0.8
+    assert sr_poisoned > sr_clean + 0.5, (sr_clean, sr_poisoned)
+
+
+def test_edge_case_attack_preserves_padding():
+    """Poisoning must never write into padded (mask==0) rows — those rows
+    are invisible to training and writing them would silently change
+    nothing, hiding a broken fraction accounting."""
+    spec = {"poisoned_client_ids": [0], "source_class": 7,
+            "target_class": 1, "sample_frac": 1.0, "tail_frac": 0.5}
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "digits", "partition_method": "hetero",
+                      "partition_alpha": 0.5},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 6, "client_num_per_round": 6,
+            "comm_round": 1, "epochs": 1, "batch_size": 32,
+            "learning_rate": 0.1,
+        },
+        "security_args": {"enable_attack": True,
+                          "attack_type": "edge_case_backdoor",
+                          "attack_spec": spec},
+        "comm_args": {"backend": "sp"},
+    })
+    sim = Simulator(cfg)
+    mask0 = np.asarray(sim.dataset.mask_train[0])
+    y0 = np.asarray(sim.data["y"][0])
+    pad = mask0 == 0
+    assert np.all(y0[pad] == np.asarray(sim.dataset.y_train[0])[pad])

@@ -18,6 +18,7 @@ from fedml_tpu.parallel.round import build_round_fn
 
 @pytest.mark.parametrize("name", ["mobilenet", "mobilenet_v3",
                                   "efficientnet", "vgg11"])
+@pytest.mark.slow
 def test_cv_models_forward(name):
     kw = {"width": 0.25} if name != "vgg11" else {}
     model = hub.create(name, 10, **kw)
@@ -111,6 +112,7 @@ def test_multilabel_federated_round():
 
 
 # --------------------------------------------------------------------- FedGAN
+@pytest.mark.slow
 def test_fedgan_round_trains_both_networks():
     models = hub.create("gan", 0, img_size=8, latent=8, width=8)
     t = TrainArgs(epochs=1, batch_size=8, learning_rate=2e-3)

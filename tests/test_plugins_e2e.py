@@ -57,6 +57,7 @@ def test_stateful_defense_foolsgold():
     assert np.isfinite(hist[-1]["train_loss"])
 
 
+@pytest.mark.slow
 def test_ldp_round_and_accountant():
     cfg = _cfg(dp_args={
         "enable_dp": True, "dp_solution_type": "ldp", "epsilon": 0.9,
@@ -68,6 +69,7 @@ def test_ldp_round_and_accountant():
     assert hist[-1]["dp_epsilon"] > 0
 
 
+@pytest.mark.slow
 def test_cdp_round():
     cfg = _cfg(dp_args={
         "enable_dp": True, "dp_solution_type": "cdp", "epsilon": 0.9,

@@ -32,7 +32,8 @@ def _cfg(model: str) -> dict:
     }
 
 
-@pytest.mark.parametrize("model", ["lr", "mlp"])
+@pytest.mark.parametrize("model", [
+    pytest.param("lr", marks=pytest.mark.slow), "mlp"])
 def test_final_accuracy_parity_digits_noniid(model):
     cfg = fedml_tpu.init(config=_cfg(model))
     sim = Simulator(cfg)

@@ -98,6 +98,7 @@ def test_cli_version_and_env():
     assert "jax" in info and "devices" in info
 
 
+@pytest.mark.slow
 def test_cli_run_simulation(tmp_path):
     cfg_yaml = tmp_path / "cfg.yaml"
     cfg_yaml.write_text("""

@@ -1,5 +1,6 @@
 """Scheduler (reference: core/schedule/ via fedavg_seq)."""
 import numpy as np
+import pytest
 
 from fedml_tpu.schedule import (
     RuntimeEstimator, dp_schedule, generate_client_schedule, linear_fit,
@@ -71,6 +72,7 @@ def test_balanced_lpt_equal_slots_and_better_makespan():
     assert sorted(j for jobs in sched for j in jobs) == list(range(8))
 
 
+@pytest.mark.slow
 def test_simulator_schedules_heterogeneous_clients_across_devices():
     """The Parrot schedule wired into the mesh path: skewed per-client counts
     must not land on one chip; the round still computes the same global model

@@ -7,6 +7,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.comm import FedCommManager
 from fedml_tpu.comm.loopback import LoopbackTransport, release_router
@@ -113,6 +114,7 @@ class _DroppingTrainer:
         return self.inner.train(params, round_idx)
 
 
+@pytest.mark.slow
 def test_secagg_unmask_quorum_failure_is_loud():
     """If survivors' unmask replies can't reach t+1 (a survivor dies between
     masked upload and share reply), the server fails with error set instead
@@ -154,6 +156,7 @@ def test_secagg_unmask_quorum_failure_is_loud():
     assert server.error is not None and "unmask" in server.error
 
 
+@pytest.mark.slow
 def test_secagg_dropout_recovery():
     """Client 3 dies after round 0; the server reconstructs its sk from
     survivor shares, strips its pairwise masks, and the run matches plain

@@ -6,6 +6,7 @@ import urllib.request
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import fedml_tpu
 from fedml_tpu.llm import TransformerLM
@@ -83,6 +84,7 @@ def test_greedy_lm_predictor():
     assert out2["generated_tokens"] == out["generated_tokens"]
 
 
+@pytest.mark.slow
 def test_serve_trained_simulator_and_checkpoint(tmp_path):
     cfg = fedml_tpu.init(config={
         "data_args": {"dataset": "synthetic",

@@ -1,0 +1,180 @@
+"""TFF-h5 dataset formats (data/tff_h5.py) + poisoned/edge-case sets
+(data/poison.py) + the invert-gradient and edge-case-backdoor attacks.
+
+No real TFF files ship in this image, so each format test GENERATES a tiny
+h5 in the exact TFF layout (examples/<client>/<field>) and drives the
+loader — the format contract is what's under test (reference:
+data/fed_cifar100/data_loader.py:27-73, fed_shakespeare/utils.py,
+stackoverflow_{nwp,lr}/).
+"""
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.data import loader as data_loader
+from fedml_tpu.data import tff_h5
+from fedml_tpu.data.poison import (
+    backdoor_eval_set, edge_case_pool, pixel_trigger, replace_with_edge_cases,
+)
+
+
+def _cfg(dataset, cache_dir, n_clients=3, batch=4, extra=None, model="lr",
+         task=None):
+    train_extra = {"task": task} if task else {}
+    return fedml_tpu.init(config={
+        "data_args": {"dataset": dataset, "data_cache_dir": str(cache_dir),
+                      "extra": extra or {}},
+        "model_args": {"model": model},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": n_clients,
+            "client_num_per_round": n_clients,
+            "comm_round": 1, "epochs": 1, "batch_size": batch,
+            "learning_rate": 0.1, "extra": train_extra,
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": "sp"},
+    })
+
+
+def _write_tff(path, clients: dict):
+    import h5py
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with h5py.File(path, "w") as f:
+        ex = f.create_group("examples")
+        for cid, fields in clients.items():
+            g = ex.create_group(cid)
+            for name, arr in fields.items():
+                g.create_dataset(name, data=arr)
+
+
+def test_fed_cifar100_h5(tmp_path):
+    rng = np.random.RandomState(0)
+    mk = lambda n: {"image": rng.randint(0, 255, (n, 32, 32, 3), np.uint8),
+                    "label": rng.randint(0, 100, (n,))}
+    _write_tff(tmp_path / "fed_cifar100" / "fed_cifar100_train.h5",
+               {f"c{i}": mk(6 + i) for i in range(4)})
+    _write_tff(tmp_path / "fed_cifar100" / "fed_cifar100_test.h5",
+               {"t0": mk(10)})
+    cfg = _cfg("fed_cifar100", tmp_path, n_clients=3)
+    ds = data_loader.load(cfg)
+    assert not ds.synthetic
+    assert ds.num_clients == 3 and ds.num_classes == 100
+    # natural partitioning: counts come from the file, not Dirichlet
+    assert list(ds.counts) == [6, 7, 8]
+    assert ds.x_train.shape[2:] == (32, 32, 3)
+    assert ds.x_train.max() <= 1.0  # uint8 -> [0,1]
+
+
+def test_fed_shakespeare_h5_and_training(tmp_path):
+    snips = lambda texts: np.array([t.encode() for t in texts], dtype="S200")
+    _write_tff(tmp_path / "fed_shakespeare" / "shakespeare_train.h5", {
+        "a": {"snippets": snips(["to be or not to be " * 8])},
+        "b": {"snippets": snips(["all the world is a stage " * 6])},
+    })
+    _write_tff(tmp_path / "fed_shakespeare" / "shakespeare_test.h5", {
+        "t": {"snippets": snips(["the rest is silence " * 5])}})
+    cfg = _cfg("fed_shakespeare", tmp_path, n_clients=2, model="rnn",
+               task="nwp")
+    ds = data_loader.load(cfg)
+    assert not ds.synthetic
+    assert ds.num_classes == tff_h5.SHAKESPEARE_VOCAB
+    assert ds.x_train.shape[-1] == tff_h5.SHAKESPEARE_SEQ_LEN
+    assert ds.y_train.shape == ds.x_train.shape  # per-position NWP targets
+    # shifted-by-one contract: y[t] == x[t+1] wherever both are real chars
+    x0, y0 = ds.x_train[0, 0], ds.y_train[0, 0]
+    assert np.array_equal(x0[1:][x0[1:] > 0], y0[:-1][x0[1:] > 0])
+
+
+def test_stackoverflow_nwp_h5(tmp_path):
+    toks = lambda ts: np.array([t.encode() for t in ts], dtype="S100")
+    _write_tff(tmp_path / "stackoverflow" / "stackoverflow_train.h5", {
+        "u1": {"tokens": toks(["how do i parse json in python",
+                               "python list comprehension question"]),
+               "title": toks(["json parse", "list question"]),
+               "tags": toks(["python|json", "python"])},
+        "u2": {"tokens": toks(["what is a segfault in c"]),
+               "title": toks(["segfault"]),
+               "tags": toks(["c"])},
+    })
+    _write_tff(tmp_path / "stackoverflow" / "stackoverflow_test.h5", {
+        "t": {"tokens": toks(["parse json in c"]),
+              "title": toks(["parse"]), "tags": toks(["c|json"])}})
+    extra = {"so_vocab_size": 32, "so_seq_len": 8, "so_tag_size": 4}
+    cfg = _cfg("stackoverflow_nwp", tmp_path, n_clients=2, extra=extra)
+    ds = data_loader.load(cfg)
+    assert not ds.synthetic
+    assert ds.num_classes == 32 + 4
+    assert ds.x_train.shape[-1] == 8
+    assert ds.x_train[0, 0, 0] == 2  # bos opens every sequence
+
+    cfg = _cfg("stackoverflow_lr", tmp_path, n_clients=2, extra=extra,
+               task="multilabel")
+    ds = data_loader.load(cfg)
+    assert not ds.synthetic
+    assert ds.num_classes == 4                      # tag space
+    assert ds.x_train.shape[-1] == 32               # BoW over the vocab
+    assert ds.y_train.shape[-1] == 4                # multi-hot targets
+    assert set(np.unique(ds.y_train)) <= {0, 1}
+
+
+def test_stackoverflow_lr_synthetic_fallback_trains():
+    """The multilabel head finally has a consumer: lr on the multi-hot
+    synthetic fallback must learn above chance under the bce objective."""
+    cfg = _cfg("stackoverflow_lr", "/nonexistent-cache", n_clients=4,
+               batch=16, model="lr", task="multilabel")
+    cfg.train_args.comm_round = 15
+    cfg.train_args.learning_rate = 2.0
+    from fedml_tpu.simulation.simulator import Simulator
+
+    sim = Simulator(cfg)
+    assert sim.dataset.synthetic
+    sim.run(15)
+    acc = sim.evaluate()["test_acc"]   # multilabel: per-tag accuracy
+    assert acc > 0.8, acc
+
+
+def test_too_few_file_clients_raises(tmp_path):
+    rng = np.random.RandomState(0)
+    _write_tff(tmp_path / "fed_cifar100" / "fed_cifar100_train.h5",
+               {"c0": {"image": rng.randint(0, 255, (4, 32, 32, 3), np.uint8),
+                       "label": rng.randint(0, 100, (4,))}})
+    _write_tff(tmp_path / "fed_cifar100" / "fed_cifar100_test.h5",
+               {"t": {"image": rng.randint(0, 255, (4, 32, 32, 3), np.uint8),
+                      "label": rng.randint(0, 100, (4,))}})
+    with pytest.raises(ValueError, match="has 1 clients"):
+        data_loader.load(_cfg("fed_cifar100", tmp_path, n_clients=5))
+
+
+# ------------------------------------------------------------------ poison
+def test_edge_case_pool_picks_tail():
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 8).astype(np.float32)
+    y = np.zeros(100, np.int64)
+    x[:5] += 25.0  # 5 far outliers
+    pool = edge_case_pool(x, y, source_class=0, tail_frac=0.05)
+    assert pool.shape[0] == 5
+    assert np.all(np.linalg.norm(pool, axis=1) > 20)
+
+
+def test_replace_with_edge_cases_respects_mask_and_frac():
+    x = np.zeros((10, 4), np.float32)
+    y = np.arange(10, dtype=np.int64) % 3
+    mask = np.ones(10, np.float32)
+    mask[8:] = 0.0  # padding rows must never be touched
+    pool = np.full((3, 4), 7.0, np.float32)
+    x2, y2 = replace_with_edge_cases(x, y, mask, pool, target_class=9,
+                                     frac=0.5, seed=0)
+    swapped = np.flatnonzero((x2 == 7.0).all(axis=1))
+    assert len(swapped) == 4  # 50% of the 8 real rows
+    assert np.all(swapped < 8)
+    assert np.all(y2[swapped] == 9)
+
+
+def test_backdoor_eval_set_excludes_target():
+    x = np.zeros((20, 6, 6, 1), np.float32)
+    y = np.asarray([0, 1] * 10, np.int64)
+    bx, by = backdoor_eval_set(x, y, pixel_trigger(2), target_class=1)
+    assert bx.shape[0] == 10 and np.all(by == 1)
+    assert np.all(bx[:, :2, :2, :] == 1.0)

@@ -5,6 +5,7 @@ adapters)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fedml_tpu.llm import TransformerLM, lora_init, lora_merge
@@ -60,6 +61,7 @@ def test_tp_forward_matches_unsharded():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_tp_train_step_decreases_loss():
     model = _model()
     params = model.init(jax.random.key(1), jnp.zeros((1, 16), jnp.int32))["params"]
