@@ -315,12 +315,10 @@ def bench_fedllm(quick: bool = False) -> dict:
         "fedllm_adapter_payload_frac": round(
             count_params(st.params) / count_params(base), 5),
     }
-    if not quick and jax.default_backend() == "tpu":
-        out.update(bench_flash_attention())
     return out
 
 
-def bench_flash_attention(t_len: int = 4096, bh: int = 4,
+def bench_flash_attention(t_len: int = 8192, bh: int = 4,
                           d: int = 128) -> dict:
     """Pallas flash attention vs XLA's fused dense attention, fwd+bwd at
     long context (the FedLLM hot op; ops/flash_attention.py)."""
@@ -362,8 +360,8 @@ def bench_flash_attention(t_len: int = 4096, bh: int = 4,
         t_flash = min(t_flash, once(ff))
         t_dense = min(t_dense, once(fd))
     return {
-        "flash_attn_t4096_fwdbwd_ms": round(t_flash * 1e3, 2),
-        "dense_attn_t4096_fwdbwd_ms": round(t_dense * 1e3, 2),
+        f"flash_attn_t{t_len}_fwdbwd_ms": round(t_flash * 1e3, 2),
+        f"dense_attn_t{t_len}_fwdbwd_ms": round(t_dense * 1e3, 2),
         "flash_attn_speedup_vs_xla_dense": round(t_dense / t_flash, 2),
     }
 
@@ -483,6 +481,9 @@ def main():
     elif quick:
         llm["fedllm_quick_size"] = True
     if not quick and jax.default_backend() == "tpu":
+        fl = _retrying(bench_flash_attention, default=None)
+        if fl is not None:
+            llm.update(fl)
         big = _retrying(bench_fedllm_large, attempts=1, default=None)
         if big is not None:
             llm.update(big)

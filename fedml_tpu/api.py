@@ -1,0 +1,308 @@
+"""Python API — the reference's `fedml.api` surface, local-first.
+
+(reference: python/fedml/api/__init__.py:26-242 — launch_job, run_* job
+management, cluster_* lifecycle, fedml_build/train_build/federate_build
+packaging, model_* registry + deploy, logs/diagnosis. Those call the FedML
+SaaS; here every verb has a local-first implementation over this
+framework's own scheduler tier, model registry directory, and serving
+scheduler — same names, no cloud. SaaS-only verbs (login/device_bind) keep
+a local profile file so scripted flows that call them still run.)
+
+    import fedml_tpu.api as api
+    cluster = api.cluster_start(n_workers=2)
+    job_id = api.launch_job({"type": "simulation", "config": {...}},
+                            cluster=cluster)
+    api.run_status(job_id, cluster=cluster)   # -> "FINISHED"
+    api.model_create("mnist-lr", model="lr", params=trained_params)
+    dep = api.model_deploy("mnist-lr", cluster=cluster, n_replicas=2)
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+_PROFILE = os.path.expanduser("~/.fedml_tpu/profile.json")
+_REGISTRY = os.path.expanduser("~/.fedml_tpu/models")
+
+
+# ------------------------------------------------------------------ cluster
+@dataclass
+class LocalCluster:
+    """A process-local 'cluster': one MasterAgent + N WorkerAgents over the
+    loopback transport (reference: cluster_start/cluster_status — SaaS
+    clusters of bound edges; here the same lifecycle, in-process)."""
+
+    master: Any
+    workers: list = field(default_factory=list)
+    run_id: str = ""
+
+    def status(self) -> dict:
+        return {
+            "workers": {w.worker_id: w.resources for w in self.workers},
+            "jobs": {jid: j.status for jid, j in self.master.jobs.items()},
+        }
+
+    def stop(self) -> None:
+        self.master.stop()
+        for w in self.workers:
+            w.stop()
+        from .comm.loopback import release_router
+
+        release_router(self.run_id)
+
+
+def cluster_start(n_workers: int = 1, resources: Optional[dict] = None,
+                  store_path: Optional[str] = None) -> LocalCluster:
+    """reference: api cluster_start — bring up a master + workers."""
+    from .comm import FedCommManager
+    from .comm.loopback import LoopbackTransport
+    from .scheduler import MasterAgent, WorkerAgent
+
+    run_id = f"api-{uuid.uuid4().hex[:8]}"
+    master = MasterAgent(FedCommManager(LoopbackTransport(0, run_id), 0),
+                         store_path=store_path)
+    master.run()
+    cluster = LocalCluster(master, [], run_id)
+    for wid in range(1, n_workers + 1):
+        w = WorkerAgent(FedCommManager(LoopbackTransport(wid, run_id), wid),
+                        wid, resources=(resources or {}).get(wid)
+                        if isinstance(resources, dict) else resources)
+        w.run()
+        w.announce()
+        cluster.workers.append(w)
+    return cluster
+
+
+def cluster_status(cluster: LocalCluster) -> dict:
+    return cluster.status()
+
+
+def cluster_stop(cluster: LocalCluster) -> bool:
+    cluster.stop()
+    return True
+
+
+# ------------------------------------------------------------------- jobs
+def launch_job(job: dict | str, cluster: Optional[LocalCluster] = None,
+               wait: bool = False, timeout: float = 600.0):
+    """reference: api launch_job(yaml) -> submits to the Launch platform.
+    Here: submit a scheduler job spec (dict, or path to a yaml) to a
+    LocalCluster's master. Returns the job id (and the result when
+    wait=True)."""
+    import yaml
+
+    if isinstance(job, str):
+        with open(job) as f:
+            job = yaml.safe_load(f)
+    owns = cluster is None
+    if owns:
+        cluster = cluster_start(1)
+    jid = cluster.master.submit(dict(job))
+    if not wait:
+        return jid if not owns else (jid, cluster)
+    j = cluster.master.wait(jid, timeout=timeout)
+    out = {"job_id": jid, "status": j.status, "result": j.result}
+    if owns:
+        cluster.stop()
+    return out
+
+
+def run_status(job_id: str, cluster: LocalCluster) -> str:
+    """reference: api run_status — job lifecycle state."""
+    return cluster.master.status(job_id)
+
+
+def run_list(cluster: LocalCluster) -> list[dict]:
+    return [{"job_id": jid, "status": j.status, "worker": j.worker}
+            for jid, j in cluster.master.jobs.items()]
+
+
+def run_stop(job_id: str, cluster: LocalCluster) -> bool:
+    """Best-effort cancel: QUEUED jobs are removed; RUNNING jobs finish
+    (workers execute on daemon threads — the reference's SaaS kill has no
+    local analog without process isolation)."""
+    m = cluster.master
+    with m._lock:
+        if job_id in m.queue:
+            m.queue.remove(job_id)
+            m.jobs[job_id].status = "STOPPED"
+            m.jobs[job_id].done.set()
+            m._persist(m.jobs[job_id])
+            return True
+    return False
+
+
+def run_logs(log_dir: str = "./log", run: Optional[str] = None,
+             tail: int = 50) -> list[str]:
+    """reference: api run_logs — pull run logs; local: read the mlops
+    facade's per-run files."""
+    out = []
+    if not os.path.isdir(log_dir):
+        return out
+    for name in sorted(os.listdir(log_dir)):
+        if run and not name.startswith(run):
+            continue
+        p = os.path.join(log_dir, name)
+        if os.path.isfile(p):
+            with open(p) as f:
+                out.extend(f"[{name}] {ln.rstrip()}"
+                           for ln in f.readlines()[-tail:])
+    return out
+
+
+# ------------------------------------------------------------------ build
+def fedml_build(source_folder: str, entry_point: Optional[str] = None,
+                dest_folder: str = "./dist",
+                name: Optional[str] = None) -> str:
+    """reference: api fedml_build / train_build / federate_build — package
+    a job directory; local: the CLI's tarball+manifest builder. Returns the
+    package path."""
+    from .__main__ import main as cli_main
+
+    args = ["build", "--source", source_folder, "--dest", dest_folder]
+    if entry_point:
+        args += ["--entry", entry_point]
+    if name:
+        args += ["--name", name]
+    rc = cli_main(args)
+    if rc != 0:
+        raise RuntimeError(f"build failed (rc={rc}) for {source_folder}")
+    pkg = name or os.path.basename(os.path.abspath(source_folder).rstrip("/"))
+    return os.path.join(dest_folder, f"{pkg}.tar.gz")
+
+
+train_build = fedml_build
+federate_build = fedml_build
+
+
+# ----------------------------------------------------------- model registry
+def _registry_dir(name: str) -> str:
+    return os.path.join(_REGISTRY, name)
+
+
+def model_create(name: str, model: str, params: Any = None,
+                 num_classes: int = 10, model_config: Optional[dict] = None,
+                 input_shape: Optional[tuple] = None) -> str:
+    """reference: api model_create — register a servable model. Local
+    registry layout: ~/.fedml_tpu/models/<name>/{spec.json, params.npz}."""
+    import jax
+
+    d = _registry_dir(name)
+    os.makedirs(d, exist_ok=True)
+    spec = {"name": name, "model": model, "num_classes": int(num_classes),
+            "model_args": dict(model_config or {}), "created": time.time(),
+            "input_shape": list(input_shape) if input_shape else None}
+    with open(os.path.join(d, "spec.json"), "w") as f:
+        json.dump(spec, f, indent=2)
+    if params is not None:
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        arrays = {
+            "/".join(str(getattr(p, "key", p)) for p in path):
+                np.asarray(leaf)
+            for path, leaf in flat}
+        np.savez(os.path.join(d, "params.npz"), **arrays)
+    return d
+
+
+def model_list(name: Optional[str] = None) -> list[str]:
+    if not os.path.isdir(_REGISTRY):
+        return []
+    names = sorted(os.listdir(_REGISTRY))
+    return [n for n in names if name is None or name in n]
+
+
+def model_delete(name: str) -> bool:
+    import shutil
+
+    d = _registry_dir(name)
+    if not os.path.isdir(d):
+        return False
+    shutil.rmtree(d)
+    return True
+
+
+def model_package(name: str, dest_folder: str = "./dist") -> str:
+    """reference: api model_package — bundle a registered model for
+    distribution (the local analog of model_push's artifact)."""
+    d = _registry_dir(name)
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"no registered model {name!r}")
+    return fedml_build(d, dest_folder=dest_folder, name=f"model-{name}")
+
+
+def _load_registered(name: str) -> dict:
+    d = _registry_dir(name)
+    with open(os.path.join(d, "spec.json")) as f:
+        spec = json.load(f)
+    pf = os.path.join(d, "params.npz")
+    if os.path.exists(pf):
+        blob = np.load(pf)
+        params: dict = {}
+        for key in blob.files:
+            node = params
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = blob[key]
+        spec["params"] = params
+    return spec
+
+
+def model_deploy(name: str, cluster: LocalCluster, n_replicas: int = 1,
+                 timeout: float = 60.0):
+    """reference: api model_deploy — deploy a registered model to workers;
+    local: the serving scheduler's deploy FSM over the cluster's master.
+    Returns the Deployment (attach an InferenceGateway for routing)."""
+    from .serving.scheduler import Deployment
+
+    spec = _load_registered(name)
+    serve_spec = {"model": spec["model"],
+                  "num_classes": spec["num_classes"],
+                  "model_args": spec.get("model_args", {}),
+                  "params": spec.get("params"),
+                  "requirements": {}}
+    dep = Deployment(cluster.master, serve_spec, min_replicas=n_replicas,
+                     max_replicas=max(n_replicas, len(cluster.workers)))
+    dep.deploy(n_replicas, timeout=timeout)
+    return dep
+
+
+# ------------------------------------------------------ profile (no SaaS)
+def fedml_login(api_key: Optional[str] = None) -> dict:
+    """reference: api fedml_login — SaaS auth. No cloud exists here; the
+    local analog records a profile so scripted flows that login first keep
+    working, and is explicit about its scope."""
+    os.makedirs(os.path.dirname(_PROFILE), exist_ok=True)
+    profile = {"api_key": api_key, "mode": "local",
+               "note": "fedml_tpu is local-first; no SaaS account exists",
+               "logged_in_at": time.time()}
+    with open(_PROFILE, "w") as f:
+        json.dump(profile, f, indent=2)
+    return profile
+
+
+def logout() -> bool:
+    if os.path.exists(_PROFILE):
+        os.remove(_PROFILE)
+        return True
+    return False
+
+
+def fedml_diagnosis() -> dict:
+    """reference: api fedml_diagnosis — connectivity probes; local: the
+    CLI's transport/device checks, returned as a dict."""
+    import io
+    from contextlib import redirect_stdout
+
+    from .__main__ import cmd_diagnosis
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cmd_diagnosis(None)
+    return json.loads(buf.getvalue())

@@ -71,3 +71,30 @@ class LoopbackTransport(BaseTransport):
     def stop_receive_message(self) -> None:
         self._running = False
         self._inbox.put(self._STOP)
+
+
+class JitterLoopbackTransport(LoopbackTransport):
+    """Loopback with seeded per-send delays — the race-detection harness.
+
+    Sleeping a random (seeded) interval before each enqueue varies the
+    ARRIVAL ORDER across participants while preserving per-sender FIFO
+    (what real transports guarantee), so repeated runs under different
+    seeds systematically explore comm-FSM interleavings: late pk arrivals,
+    unmask replies racing round timers, status messages crossing model
+    syncs. Protocol outcomes must be timing-independent — tests assert
+    bit-equal results across seeds (tests/test_race_interleaving.py;
+    SURVEY §5.2 race-detection strategy)."""
+
+    def __init__(self, rank: int, run_id: str = "default", seed: int = 0,
+                 max_delay: float = 0.01):
+        super().__init__(rank, run_id)
+        import random
+
+        self._rng = random.Random(seed * 7919 + rank * 104729)
+        self.max_delay = max_delay
+
+    def send_message(self, msg: Message) -> None:
+        import time
+
+        time.sleep(self._rng.random() * self.max_delay)
+        super().send_message(msg)

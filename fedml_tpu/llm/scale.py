@@ -60,7 +60,7 @@ def make_ring_attn_fn(mesh: Mesh, seq_axis: str = "seq",
 
 def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
                         d_model: int, n_layers: int, n_heads: int,
-                        d_ff: int, t_len: int, rank: int = 8,
+                        d_ff: int, rank: int = 8,
                         alpha: float = 16.0, lr: float = 1e-3,
                         seq_axis: Optional[str] = "seq",
                         dp_axis: str = "dp",
@@ -71,8 +71,11 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
     (adapters, loss) trains ONLY the adapters against the TP-sharded frozen
     base with ring attention + remat under one jit."""
     rng = jax.random.key(0) if rng is None else rng
+    # a mesh without the seq axis degrades to dense attention AND an
+    # unsharded sequence dim — both guards must agree on mesh membership
+    has_seq = bool(seq_axis) and seq_axis in mesh.axis_names
     attn = (make_ring_attn_fn(mesh, seq_axis=seq_axis, dp_axis=dp_axis)
-            if seq_axis and seq_axis in mesh.axis_names else None)
+            if has_seq else None)
     model = model_cls(vocab_size=vocab_size, d_model=d_model,
                       n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
                       attn_fn=attn, remat=True)
@@ -95,7 +98,7 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
     adapters = lora_init(jax.random.fold_in(rng, 1), base, rank=rank)
 
     batch_spec = NamedSharding(
-        mesh, P(dp_axis, seq_axis if seq_axis else None))
+        mesh, P(dp_axis, seq_axis if has_seq else None))
 
     # base rides as a jit ARGUMENT: closing over a multi-GB pytree captures
     # it as lowering constants (minutes of extra compile at the 1B scale)

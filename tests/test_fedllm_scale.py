@@ -20,7 +20,7 @@ VOCAB, D, L, H, FF, T = 64, 32, 2, 4, 64, 16
 def _build(mesh, seq_axis="seq"):
     return build_scaled_fedllm(
         TransformerLM, mesh, vocab_size=VOCAB, d_model=D, n_layers=L,
-        n_heads=H, d_ff=FF, t_len=T, rank=4, lr=0.5, seq_axis=seq_axis,
+        n_heads=H, d_ff=FF, rank=4, lr=0.5, seq_axis=seq_axis,
         compute_dtype="float32")
 
 

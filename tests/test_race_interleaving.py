@@ -1,0 +1,115 @@
+"""Systematic comm-FSM interleaving tests (SURVEY §5.2 race detection;
+round-2 verdict listed this as the remaining race-coverage gap).
+
+JitterLoopbackTransport injects seeded per-send delays, varying message
+ARRIVAL ORDER across participants (per-sender FIFO preserved — what real
+transports guarantee) while the protocol math stays deterministic. Each
+protocol must therefore produce BIT-EQUAL results under every seed; any
+divergence is an interleaving bug (e.g. a handler mutating state it
+shouldn't before a guard)."""
+import uuid
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import FedCommManager
+from fedml_tpu.comm.loopback import (
+    JitterLoopbackTransport, LoopbackTransport, release_router,
+)
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.cross_silo import FedClientManager, FedServerManager
+from fedml_tpu.cross_silo.secagg_manager import (
+    SecAggClientManager, SecAggServerManager,
+)
+from fedml_tpu.cross_silo.trainer import SiloTrainer
+from fedml_tpu.models import hub
+
+
+def _mk_data(seed, n=48, d=8, k=3):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _transport(rank, run_id, seed):
+    if seed is None:
+        return LoopbackTransport(rank, run_id)
+    return JitterLoopbackTransport(rank, run_id, seed=seed, max_delay=0.008)
+
+
+def _run_secagg_jittered(seed, n_clients=4, rounds=2):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    client_ids = list(range(1, n_clients + 1))
+    run_id = f"race-sa-{uuid.uuid4().hex[:6]}"
+    server = SecAggServerManager(
+        FedCommManager(_transport(0, run_id, seed), 0),
+        client_ids=client_ids, init_params=params_np, num_rounds=rounds)
+    clients = []
+    for i, cid in enumerate(client_ids):
+        tr = SiloTrainer(model.apply, t, *_mk_data(i), seed=100 + i)
+        tr.train(params_np, 0)  # warm jit outside the protocol
+        clients.append(SecAggClientManager(
+            FedCommManager(_transport(cid, run_id, seed), cid), cid, tr,
+            num_clients=n_clients, client_ids=client_ids))
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+        c.announce_ready()
+    assert server.done.wait(timeout=180), f"seed={seed}: server hung"
+    assert server.error is None, server.error
+    release_router(run_id)
+    return server.params
+
+
+def _run_cross_silo_jittered(seed, n_clients=3, rounds=3):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    client_ids = list(range(1, n_clients + 1))
+    run_id = f"race-cs-{uuid.uuid4().hex[:6]}"
+    server = FedServerManager(
+        FedCommManager(_transport(0, run_id, seed), 0),
+        client_ids=client_ids, init_params=params_np, num_rounds=rounds)
+    clients = []
+    for i, cid in enumerate(client_ids):
+        tr = SiloTrainer(model.apply, t, *_mk_data(i), seed=100 + i)
+        tr.train(params_np, 0)
+        clients.append(FedClientManager(
+            FedCommManager(_transport(cid, run_id, seed), cid), cid, tr))
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+        c.announce_ready()
+    assert server.done.wait(timeout=180), f"seed={seed}: server hung"
+    release_router(run_id)
+    return server.params
+
+
+@pytest.mark.slow
+def test_secagg_fsm_timing_independent():
+    """pk exchange, encrypted share routing, masked upload, every-round
+    collected unmask — all under shuffled arrival orders: results must be
+    bit-equal to the jitter-free run for every seed."""
+    baseline = _run_secagg_jittered(None)
+    for seed in range(4):
+        got = _run_secagg_jittered(seed)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), baseline, got)
+
+
+@pytest.mark.slow
+def test_cross_silo_fsm_timing_independent():
+    baseline = _run_cross_silo_jittered(None)
+    for seed in range(4):
+        got = _run_cross_silo_jittered(seed)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), baseline, got)
