@@ -21,6 +21,7 @@ implement, no changes above L0). Key semantics preserved from MQTT+S3:
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 import uuid
 from collections import defaultdict, deque
@@ -70,6 +71,48 @@ class InMemoryBroker:
             return self._blobs.pop(key) if delete else self._blobs[key]
 
 
+class ContentAddressedBroker(InMemoryBroker):
+    """Broker whose blob plane is CONTENT-ADDRESSED — the MQTT+Web3/Theta
+    transport shape (reference: core/distributed/communication/
+    mqtt_web3/mqtt_web3_comm_manager.py and mqtt_thetastore/ — decentralized
+    stores address blobs by content hash, not bucket key). Semantics gained
+    over the S3-style plane:
+
+    - dedup: broadcasting one model to n clients stores ONE blob (the key
+      is sha256(content)); refcounts track outstanding readers.
+    - integrity: get_blob re-hashes and refuses tampered content — the
+      decentralized-storage trust model, where the store is not trusted.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._refs: dict[str, int] = {}
+
+    def put_blob(self, data: bytes) -> str:
+        key = hashlib.sha256(data).hexdigest()
+        with self._cv:
+            if key in self._blobs:
+                self._refs[key] += 1          # dedup hit
+            else:
+                self._blobs[key] = bytes(data)
+                self._refs[key] = 1
+        return key
+
+    def get_blob(self, key: str, delete: bool = True) -> bytes:
+        with self._cv:
+            data = self._blobs[key]
+            if delete:
+                self._refs[key] -= 1
+                if self._refs[key] <= 0:
+                    del self._blobs[key]
+                    del self._refs[key]
+        if hashlib.sha256(data).hexdigest() != key:
+            raise ValueError(
+                f"content-addressed blob {key[:12]}… failed hash "
+                "verification — storage corrupted or tampered")
+        return data
+
+
 _brokers: dict[str, InMemoryBroker] = {}
 _brokers_lock = threading.Lock()
 
@@ -81,9 +124,24 @@ def get_broker(broker_id: str = "default") -> InMemoryBroker:
         return _brokers[broker_id]
 
 
+def get_cas_broker(broker_id: str = "default") -> ContentAddressedBroker:
+    """Shared content-addressed broker for a run (the web3 backend's
+    registry; namespaced so a run can use both planes side by side)."""
+    key = f"cas:{broker_id}"
+    with _brokers_lock:
+        if key not in _brokers:
+            _brokers[key] = ContentAddressedBroker()
+        return _brokers[key]  # type: ignore[return-value]
+
+
 def release_broker(broker_id: str) -> None:
+    """Drops BOTH planes of a run: the plain broker and its content-
+    addressed companion (get_cas_broker registers under cas:<id>) — a
+    survivor would hand stale store-and-forward frames to the next run
+    that reuses the id."""
     with _brokers_lock:
         _brokers.pop(broker_id, None)
+        _brokers.pop(f"cas:{broker_id}", None)
 
 
 class BrokerTransport(BaseTransport):
@@ -110,8 +168,16 @@ class BrokerTransport(BaseTransport):
     def send_message(self, msg: Message) -> None:
         frame = msg.encode()
         if len(frame) > self.blob_threshold:
-            key = self.broker.put_blob(frame)
-            frame = _BLOB_KEY_PREFIX + key.encode()
+            # blob a RECEIVER-CANONICAL frame (receiver forced to -1) and
+            # carry the envelope in the topic message: a broadcast of one
+            # payload to n receivers then hashes identically, so the
+            # content-addressed plane stores ONE blob (refcounted n) —
+            # per-receiver frames would defeat dedup by construction
+            canonical = Message(msg.type, msg.sender_id, -1,
+                                msg.params).encode()
+            key = self.broker.put_blob(canonical)
+            frame = (_BLOB_KEY_PREFIX + key.encode()
+                     + b"|" + str(msg.receiver_id).encode())
         self.broker.publish(self._topic(msg.receiver_id), frame)
 
     def handle_receive_message(self) -> None:
@@ -124,8 +190,12 @@ class BrokerTransport(BaseTransport):
             if frame is None:
                 continue
             if frame.startswith(_BLOB_KEY_PREFIX):
-                frame = self.broker.get_blob(
-                    frame[len(_BLOB_KEY_PREFIX):].decode())
+                key, _, receiver = (
+                    frame[len(_BLOB_KEY_PREFIX):].decode().partition("|"))
+                msg = Message.decode(self.broker.get_blob(key))
+                msg.receiver_id = int(receiver) if receiver else self.rank
+                self._notify(msg)
+                continue
             self._notify(Message.decode(frame))
 
     def stop_receive_message(self) -> None:

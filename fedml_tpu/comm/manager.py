@@ -97,6 +97,15 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
         from .broker import BrokerTransport
 
         return BrokerTransport(rank, run_id, **kw)
+    if b in ("mqtt_web3", "mqtt_thetastore", "web3"):
+        # decentralized-storage shape: content-addressed, hash-verified,
+        # deduplicating blob plane (reference: mqtt_web3/ + mqtt_thetastore/
+        # comm managers)
+        from .broker import BrokerTransport, get_cas_broker
+
+        if "broker" not in kw:
+            kw["broker"] = get_cas_broker(run_id)
+        return BrokerTransport(rank, run_id, **kw)
     if b in ("trpc", "mpi"):
         raise ValueError(
             f"backend {b!r} is a reference transport not provided in the TPU "

@@ -60,6 +60,73 @@ class WandbSink:
             self._wandb.log(_jsonable(payload))
 
 
+class BrokerLogSink:
+    """Ship events OFF-BOX through the broker transport — the log-upload
+    leg of the reference's log daemon (reference: core/mlops/
+    mlops_runtime_log_daemon.py posts log batches to the cloud; here the
+    collector is any process that drains the run's log topic — the same
+    store-and-forward broker the cross-cloud runtime already uses, so
+    logs survive collector downtime).
+
+    Batches rows and publishes JSON frames to topic `fedml_logs_<run>`;
+    `collect_logs` is the daemon-side drain."""
+
+    def __init__(self, run_name: str, broker_id: str = "default",
+                 source: str = "", batch_size: int = 20):
+        from ..comm.broker import get_broker
+
+        self.broker = get_broker(broker_id)
+        self.topic = f"fedml_logs_{run_name}"
+        self.source = source
+        self.batch_size = batch_size
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+
+    def __call__(self, kind: str, payload: dict) -> None:
+        row = {"t": time.time(), "kind": kind, "source": self.source,
+               **_jsonable(payload)}
+        with self._lock:
+            self._buf.append(row)
+            if len(self._buf) >= self.batch_size:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf:
+            self.broker.publish(self.topic, json.dumps(self._buf).encode())
+            self._buf = []
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    close = flush
+
+
+def collect_logs(run_name: str, broker_id: str = "default",
+                 out_dir: Optional[str] = None,
+                 timeout: float = 0.05) -> list[dict]:
+    """Collector-side drain of a run's shipped logs (the reference's cloud
+    log service role). Returns the rows; also appends them to
+    <out_dir>/<run_name>.collected.jsonl when out_dir is given."""
+    from ..comm.broker import get_broker
+
+    broker = get_broker(broker_id)
+    topic = f"fedml_logs_{run_name}"
+    rows: list[dict] = []
+    while True:
+        frame = broker.poll(topic, timeout=timeout)
+        if frame is None:
+            break
+        rows.extend(json.loads(frame))
+    if out_dir and rows:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, f"{run_name}.collected.jsonl"),
+                  "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    return rows
+
+
 def _jsonable(d: dict) -> dict:
     out = {}
     for k, v in d.items():
@@ -94,4 +161,14 @@ def attach_from_config(cfg) -> list:
             attached.append(wsink)
         except Exception:  # wandb absent or offline — tracked locally only
             pass
+    # off-box shipping: tracking_args.extra.log_upload_broker names the
+    # broker id; a collector drains with utils.sinks.collect_logs
+    bid = t.extra.get("log_upload_broker")
+    bkey = ("broker", str(bid), t.run_name)
+    if bid and bkey not in existing:
+        bsink = BrokerLogSink(t.run_name, broker_id=str(bid),
+                              source=t.extra.get("log_source", ""))
+        bsink._attach_key = bkey
+        recorder.sinks.append(bsink)
+        attached.append(bsink)
     return attached
