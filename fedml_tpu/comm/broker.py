@@ -166,18 +166,19 @@ class BrokerTransport(BaseTransport):
         return f"fedml_{self.run_id}_{rank}"
 
     def send_message(self, msg: Message) -> None:
-        frame = msg.encode()
-        if len(frame) > self.blob_threshold:
-            # blob a RECEIVER-CANONICAL frame (receiver forced to -1) and
-            # carry the envelope in the topic message: a broadcast of one
-            # payload to n receivers then hashes identically, so the
-            # content-addressed plane stores ONE blob (refcounted n) —
-            # per-receiver frames would defeat dedup by construction
-            canonical = Message(msg.type, msg.sender_id, -1,
-                                msg.params).encode()
+        # encode the RECEIVER-CANONICAL frame first (receiver forced to -1):
+        # on the blob path it is the ONLY full serialization (a broadcast of
+        # one payload to n receivers hashes identically, so the content-
+        # addressed plane stores ONE blob, refcounted n); below the
+        # threshold the re-encode with the true receiver is cheap by
+        # definition
+        canonical = Message(msg.type, msg.sender_id, -1, msg.params).encode()
+        if len(canonical) > self.blob_threshold:
             key = self.broker.put_blob(canonical)
             frame = (_BLOB_KEY_PREFIX + key.encode()
                      + b"|" + str(msg.receiver_id).encode())
+        else:
+            frame = msg.encode()
         self.broker.publish(self._topic(msg.receiver_id), frame)
 
     def handle_receive_message(self) -> None:

@@ -31,6 +31,47 @@ def make_mesh(axes: Optional[dict] = None, devices=None) -> Mesh:
     return Mesh(arr, tuple(axes.keys()))
 
 
+def mesh_from_file(path: str, devices=None) -> Mesh:
+    """Device-mapping FILE -> Mesh (the reference's gpu_mapping.yaml analog:
+    reference: training docs' gpu_mapping_file maps hostnames to worker
+    counts for MPI placement; on TPU the placement object is the mesh, so
+    the file declares named axes and, optionally, an explicit device
+    id order for axis locality):
+
+        mesh:               # ordered {axis: size}; -1 = all remaining
+          silos: 2
+          intra: -1
+        device_ids: [0, 2, 1, 3]     # optional reorder (ICI locality)
+
+    Configs reach it via device_args.extra.mesh_mapping_file; inline
+    device_args.mesh_shape keeps working and wins when both are set."""
+    import yaml
+
+    with open(path) as f:
+        spec = yaml.safe_load(f) or {}
+    if "mesh" not in spec or not isinstance(spec["mesh"], dict):
+        raise ValueError(
+            f"mesh mapping file {path!r} needs a 'mesh: {{axis: size}}' "
+            "section")
+    devices = devices if devices is not None else jax.devices()
+    ids = spec.get("device_ids")
+    if ids is not None:
+        if len(set(ids)) != len(ids):
+            dupes = sorted({i for i in ids if ids.count(i) > 1})
+            raise ValueError(
+                f"mesh mapping file repeats device ids {dupes} — a mesh "
+                "aliasing one chip twice fails much later with an opaque "
+                "sharding error")
+        by_id = {d.id: d for d in devices}
+        missing = [i for i in ids if i not in by_id]
+        if missing:
+            raise ValueError(
+                f"mesh mapping file names device ids {missing} not present "
+                f"(have {sorted(by_id)})")
+        devices = [by_id[i] for i in ids]
+    return make_mesh(spec["mesh"], devices=devices)
+
+
 def client_sharding(mesh: Mesh, axis: str = "clients") -> NamedSharding:
     """Shard the leading (client) axis across the mesh; replicate the rest."""
     return NamedSharding(mesh, P(axis))

@@ -85,8 +85,15 @@ class Simulator:
         if mesh is not None:
             self.mesh = mesh
         elif use_mesh:
-            axes = cfg.device_args.mesh_shape or {"clients": len(jax.devices())}
-            self.mesh = make_mesh(axes)
+            mapping = cfg.device_args.extra.get("mesh_mapping_file")
+            if cfg.device_args.mesh_shape:
+                self.mesh = make_mesh(cfg.device_args.mesh_shape)
+            elif mapping:
+                from ..parallel.mesh import mesh_from_file
+
+                self.mesh = mesh_from_file(mapping)
+            else:
+                self.mesh = make_mesh({"clients": len(jax.devices())})
         else:
             self.mesh = None
 
@@ -319,6 +326,9 @@ class Simulator:
                 (r + 1) % checkpoint_every == 0 or r == rounds - 1
             ):
                 self.save(checkpoint_dir)
+        from ..utils.sinks import flush_sinks
+
+        flush_sinks()  # ship any buffered telemetry (BrokerLogSink batches)
         return self.history
 
 

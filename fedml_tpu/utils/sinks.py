@@ -138,6 +138,14 @@ def _jsonable(d: dict) -> dict:
     return out
 
 
+def flush_sinks() -> None:
+    """Flush every attached sink that buffers (BrokerLogSink batches rows;
+    without this a short run's tail batch would never ship). Called by the
+    Simulator at end-of-run and by mlops.finish."""
+    for s in list(recorder.sinks):
+        getattr(s, "flush", lambda: None)()
+
+
 def attach_from_config(cfg) -> list:
     """Register sinks per tracking_args; returns the attached sink objects.
     Idempotent per (dir, run_name): repeated init calls don't double-log."""

@@ -92,3 +92,56 @@ def test_shakespeare_synthetic_fallback_trains_rnn(tmp_path):
     cfg.validation_args.frequency_of_the_test = 0
     hist = fedml_tpu.run_simulation(cfg)
     assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+
+
+def test_mesh_mapping_file(tmp_path):
+    """Device-mapping file -> Mesh (reference gpu_mapping.yaml analog) and
+    the config path through the Simulator."""
+    import jax
+    import pytest as _pytest
+
+    import fedml_tpu
+    from fedml_tpu.parallel.mesh import mesh_from_file
+    from fedml_tpu.simulation.simulator import Simulator
+
+    f = tmp_path / "mapping.yaml"
+    f.write_text("mesh:\n  silos: 2\n  intra: -1\n")
+    mesh = mesh_from_file(str(f))
+    assert mesh.axis_names == ("silos", "intra")
+    assert mesh.devices.shape == (2, len(jax.devices()) // 2)
+
+    # explicit device order
+    ids = [d.id for d in jax.devices()][::-1]
+    f2 = tmp_path / "m2.yaml"
+    f2.write_text("mesh:\n  clients: %d\ndevice_ids: %s\n"
+                  % (len(ids), ids))
+    mesh2 = mesh_from_file(str(f2))
+    assert [d.id for d in mesh2.devices.ravel()] == ids
+
+    with _pytest.raises(ValueError, match="mesh"):
+        f3 = tmp_path / "bad.yaml"
+        f3.write_text("nope: 1\n")
+        mesh_from_file(str(f3))
+    with _pytest.raises(ValueError, match="repeats device ids"):
+        f4 = tmp_path / "dup.yaml"
+        f4.write_text("mesh:\n  clients: 4\ndevice_ids: [0, 2, 2, 3]\n")
+        mesh_from_file(str(f4))
+
+    fc = tmp_path / "clients.yaml"
+    fc.write_text("mesh:\n  clients: -1\n")
+    cfg = fedml_tpu.init(config={
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 16}},
+        "model_args": {"model": "lr"},
+        "train_args": {"federated_optimizer": "FedAvg",
+                       "client_num_in_total": 8, "client_num_per_round": 8,
+                       "comm_round": 1, "epochs": 1, "batch_size": 8,
+                       "learning_rate": 0.3},
+        "device_args": {"extra": {"mesh_mapping_file": str(fc)}},
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": "xla"},
+    })
+    sim = Simulator(cfg)
+    assert sim.mesh is not None and sim.mesh.axis_names == ("clients",)
+    m = sim.run_round(0)
+    assert np.isfinite(m["train_loss"])

@@ -216,10 +216,8 @@ def test_log_upload_via_config(tmp_path):
                                     "log_source": "host-1"}},
     })
     try:
+        # the framework flushes buffered sinks at end-of-run — no user code
         fedml_tpu.run_simulation(cfg)
-        for s in list(recorder.sinks):
-            if hasattr(s, "flush"):
-                s.flush()
         rows = collect_logs("shipit", broker_id=bid)
         assert rows and all(r["source"] == "host-1" for r in rows)
     finally:
