@@ -15,8 +15,10 @@ TPU-native replacement for the reference operator ABCs:
 
 Lifecycle hooks (`on_before/after_local_training`, `on_before/on/after_
 aggregation` — reference: server_aggregator.py:42-83, client_trainer.py:32-59)
-are composable pytree transforms (core/hooks.py), so DP/security/compression
-stay plugins, not forks (SURVEY.md §7.3).
+are composable pytree transforms whose sites live in the round engine
+(parallel/round.py: postprocess_update / aggregate_full / postprocess_agg,
+composed by simulation/simulator.py), so DP/security/compression stay
+plugins, not forks (SURVEY.md §7.3).
 """
 from __future__ import annotations
 
