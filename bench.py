@@ -135,15 +135,8 @@ def measured_matmul_peak_tflops() -> float:
     return (2 * n**3 * chain * iters / dt) / 1e12
 
 
-def bench_accuracy_real() -> dict:
-    """FedAvg on real data (sklearn digits), 10 clients, Dirichlet non-IID —
-    JAX path AND the reference-style torch loop (fedml_tpu/parity.py) on the
-    IDENTICAL partitions; reports both accuracies and the parity delta."""
-    import fedml_tpu
-    from fedml_tpu.parity import torch_fedavg
-    from fedml_tpu.simulation.simulator import Simulator
-
-    cfg = fedml_tpu.init(config={
+def _digits_config() -> dict:
+    return {
         "data_args": {"dataset": "digits", "partition_method": "hetero",
                       "partition_alpha": 0.5},
         "model_args": {"model": "mlp"},
@@ -155,7 +148,19 @@ def bench_accuracy_real() -> dict:
         },
         "validation_args": {"frequency_of_the_test": 0},
         "comm_args": {"backend": "sp"},
-    })
+    }
+
+
+def bench_accuracy_real(quick: bool = False) -> dict:
+    """FedAvg on real data (sklearn digits), 10 clients, Dirichlet non-IID —
+    JAX path AND the reference-style torch loop (fedml_tpu/parity.py) on the
+    IDENTICAL partitions; reports both accuracies and the parity delta, plus
+    the FedOpt/FedProx/FedNova variants (BASELINE workload 3)."""
+    import fedml_tpu
+    from fedml_tpu.parity import torch_fedavg
+    from fedml_tpu.simulation.simulator import Simulator
+
+    cfg = fedml_tpu.init(config=_digits_config())
     sim = Simulator(cfg)
     sim.run(30)
     acc = sim.evaluate()["test_acc"]
@@ -167,6 +172,28 @@ def bench_accuracy_real() -> dict:
         out["parity_acc_delta"] = round(abs(acc - ref), 4)
     except Exception as e:  # noqa: BLE001
         out["parity_error"] = f"{type(e).__name__}: {e}"[:200]
+    if quick:
+        return out   # variants quadruple the accuracy portion; skip on --quick
+    # BASELINE workload 3: the server-optimizer family on the same real
+    # non-IID setup — FedOpt with a server Adam, FedProx with a stronger-
+    # than-default proximal pull (the default mu=0.01 barely moves digits),
+    # FedNova's normalized aggregation as-is. Each must stay within a few
+    # points of FedAvg.
+    variants = (
+        ("FedOpt", {"server_optimizer": "adam", "server_lr": 0.03}),
+        ("FedProx", {"fedprox_mu": 0.1}),
+        ("FedNova", {}),
+    )
+    for opt, knobs in variants:
+        try:
+            d = _digits_config()
+            d["train_args"].update({"federated_optimizer": opt, **knobs})
+            s2 = Simulator(fedml_tpu.init(config=d))
+            s2.run(30)
+            out[f"real_data_acc_{opt.lower()}"] = round(
+                s2.evaluate()["test_acc"], 4)
+        except Exception as e:  # noqa: BLE001
+            out[f"{opt.lower()}_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 
@@ -471,7 +498,7 @@ def main():
     peak = _retrying(measured_matmul_peak_tflops, default=None)
     spec_peak = tpu_spec_peak_tflops()
     achieved = (flops / round_time) / 1e12 if flops else None
-    acc = _retrying(bench_accuracy_real, default=None) or {
+    acc = _retrying(bench_accuracy_real, quick, default=None) or {
         "real_data_final_acc_digits_noniid": None}
     base_rps = _retrying(bench_torch_baseline, 2 if quick else 4,
                          default=None)
