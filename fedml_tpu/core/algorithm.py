@@ -72,6 +72,29 @@ def masked_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
     return loss, correct, mask.sum()
 
 
+NWP_PAD_ID = 0  # reference: nn.CrossEntropyLoss(ignore_index=0)
+
+
+def nwp_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    """Next-word-prediction head: per-token CE that excludes pad targets.
+
+    The reference trains NWP with `nn.CrossEntropyLoss(ignore_index=0)` and
+    masks accuracy the same way (ml/trainer/my_model_trainer_nwp.py:24,75), so
+    a pad token (id 0) anywhere in a real sequence contributes to neither loss
+    nor accuracy. The per-token mask is the per-sample pad mask [B] crossed
+    with (y != pad_id) [B, T]; padded rows have all-zero targets, so the
+    sample mask is subsumed but kept for clarity under SPMD padding.
+    """
+    tok = (mask[:, None] * (y != NWP_PAD_ID)).astype(logits.dtype).reshape(-1)
+    logits = logits.reshape(-1, logits.shape[-1])
+    y = y.reshape(-1)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    denom = jnp.maximum(tok.sum(), 1.0)
+    loss = (ce * tok).sum() / denom
+    correct = ((jnp.argmax(logits, -1) == y) * tok).sum()
+    return loss, correct, tok.sum()
+
+
 def masked_mse(pred: jax.Array, y: jax.Array, mask: jax.Array):
     """Regression objective: mean squared error over a padded batch;
     'correct' reports predictions within 0.5 of the target so the engine's
@@ -102,7 +125,7 @@ def masked_bce_multilabel(logits: jax.Array, y: jax.Array, mask: jax.Array):
 # NWP, and regression aggregator variants — ml/aggregator/)
 OBJECTIVES = {
     "classification": masked_softmax_ce,
-    "nwp": masked_softmax_ce,          # [B, T, V] handled by the CE head
+    "nwp": nwp_softmax_ce,             # pad targets (id 0) excluded, ref parity
     "regression": masked_mse,
     "multilabel": masked_bce_multilabel,
 }

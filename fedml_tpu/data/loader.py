@@ -33,7 +33,7 @@ DATASET_SHAPES = {
     "cinic10": ((32, 32, 3), 10),
     "synthetic": ((60,), 10),
     "digits": ((8, 8, 1), 10),
-    "shakespeare": ((80,), 80),   # 80-char contexts, char-vocab classes
+    "shakespeare": ((80,), 81),   # 80-char contexts; id 0 reserved for pad
     # TFF-format h5 federated sets (data/tff_h5.py; reference:
     # data/{fed_cifar100,fed_shakespeare,stackoverflow_*}/data_loader.py)
     "fed_cifar100": ((32, 32, 3), 100),
@@ -92,13 +92,15 @@ def _synthetic_for(name: str, cfg: Config) -> FedDataset:
 
         return synthetic_multilabel(cfg)
     if name in _TOKEN_TASKS:
-        # token task: sequences where next char = (char + 1) mod V —
-        # learnable by any sequence model; targets per position (NWP shape)
+        # token task: sequences where next token = wrap-around successor —
+        # learnable by any sequence model; targets per position (NWP shape).
+        # Tokens live in [1, V): id 0 is the reserved pad the nwp objective
+        # excludes, so synthetic data must not emit it as a real target.
         rng = np.random.RandomState(cfg.common_args.random_seed)
         total = int(n * 1.25)
-        starts = rng.randint(0, num_classes, size=(total, 1))
-        x = (starts + np.arange(shape[0])) % num_classes
-        y = (x + 1) % num_classes
+        starts = rng.randint(1, num_classes, size=(total, 1))
+        x = (starts - 1 + np.arange(shape[0])) % (num_classes - 1) + 1
+        y = x % (num_classes - 1) + 1
         n_test = int(total * 0.2)
         ds = _build_from_arrays(
             x[n_test:].astype(np.int64), y[n_test:].astype(np.int64),
@@ -243,16 +245,20 @@ def _leaf_json_generic(dirname: str, shape: tuple, num_classes: int,
                               pad_multiple=cfg.train_args.batch_size)
 
 
-# the reference's shakespeare char vocabulary (utils/language_utils.py)
+# the reference's shakespeare char vocabulary (utils/language_utils.py),
+# shifted by +1 so id 0 is a reserved pad — the nwp objective excludes
+# target id 0 from loss/accuracy (core/algorithm.py nwp_softmax_ce), so a
+# real character must never encode to 0 ('\n' was id 0 unshifted).
 _SHAKES_VOCAB = (
     "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ"
     "[]abcdefghijklmnopqrstuvwxyz}"
 )
-_SHAKES_CHAR = {c: i for i, c in enumerate(_SHAKES_VOCAB)}
+_SHAKES_CHAR = {c: i + 1 for i, c in enumerate(_SHAKES_VOCAB)}
+_SHAKES_UNK = _SHAKES_CHAR[" "]
 
 
 def _encode_chars(s: str) -> np.ndarray:
-    return np.asarray([_SHAKES_CHAR.get(c, 1) for c in s], np.int64)
+    return np.asarray([_SHAKES_CHAR.get(c, _SHAKES_UNK) for c in s], np.int64)
 
 
 def _leaf_shakespeare(cache_dir: Path, cfg: Config) -> FedDataset | None:

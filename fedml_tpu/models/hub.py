@@ -139,7 +139,7 @@ MODELS.register("resnet20")(
 MODELS.register("resnet56")(
     lambda num_classes, **kw: ResNet(num_classes, stage_sizes=(9, 9, 9), filters=16)
 )
-MODELS.register("rnn")(lambda num_classes, **kw: CharRNN(vocab_size=num_classes))
+MODELS.register("rnn")(lambda num_classes, **kw: CharRNN(vocab_size=num_classes, **kw))
 
 
 def _transformer_lm(num_classes, **kw):

@@ -76,6 +76,12 @@ def test_shakespeare_leaf_json(tmp_path):
     row = np.asarray(ds.x_train).reshape(-1, 80)[0]
     tgt = np.asarray(ds.y_train).reshape(-1, 80)[0]
     assert (tgt[:-1] == row[1:]).all()
+    # id 0 is the reserved pad (nwp objective drops target 0): real chars —
+    # including '\n', which was id 0 before the +1 vocab shift — never
+    # encode to 0
+    assert dl._encode_chars("\n a}").min() >= 1
+    real = np.asarray(ds.mask_train) > 0
+    assert np.asarray(ds.x_train)[real].min() >= 1
 
 
 @pytest.mark.slow

@@ -111,6 +111,71 @@ def test_multilabel_federated_round():
     assert accs[-1] > 0.8, accs
 
 
+def test_nwp_head_excludes_pad_tokens():
+    """Reference parity: CrossEntropyLoss(ignore_index=0) — pad targets (id 0)
+    inside real sequences count in neither loss nor accuracy
+    (ref ml/trainer/my_model_trainer_nwp.py:24,75). Regression for the
+    round-3 finding that the per-sample mask was repeated over tokens."""
+    from fedml_tpu.core.algorithm import masked_softmax_ce, nwp_softmax_ce
+
+    rs = np.random.RandomState(0)
+    B, T, V = 3, 8, 11
+    logits = jnp.asarray(rs.randn(B, T, V).astype(np.float32))
+    y = rs.randint(1, V, size=(B, T))
+    y[0, 5:] = 0            # pad run at the end of a real sequence
+    y[1, 2:4] = 0           # pad run in the middle
+    y = jnp.asarray(y)
+    mask = jnp.asarray([1.0, 1.0, 0.0])   # row 2 is SPMD padding entirely
+
+    loss, correct, cnt = nwp_softmax_ce(logits, y, mask)
+    # count = real tokens only: row0 has 5, row1 has 6, row2 contributes 0
+    assert float(cnt) == 11.0
+    # hand-computed masked CE over exactly those 11 positions
+    import optax
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.reshape(-1, V), y.reshape(-1))
+    tok = (mask[:, None] * (y != 0)).reshape(-1)
+    np.testing.assert_allclose(
+        float(loss), float((ce * tok).sum() / tok.sum()), rtol=1e-6)
+    # the old per-sample-repeated head counts pad positions -> different stats
+    l_old, c_old, n_old = masked_softmax_ce(logits, y, mask)
+    assert float(n_old) != float(cnt)
+    assert not np.isclose(float(l_old), float(loss))
+
+    # argmax==0 at a pad position must not count as correct: craft logits
+    # that always predict 0
+    z = jnp.zeros((B, T, V)).at[..., 0].set(10.0)
+    _, correct0, cnt0 = nwp_softmax_ce(z, y, mask)
+    assert float(correct0) == 0.0 and float(cnt0) == 11.0
+
+
+def test_nwp_federated_round_with_padding_learns():
+    """e2e: task='nwp' trains through the round engine on padded sequences;
+    accuracy is computed over non-pad tokens only."""
+    rs = np.random.RandomState(2)
+    n, s, T, V = 2, 24, 12, 9
+    x = rs.randint(1, V, size=(n, s, T)).astype(np.int32)
+    y = np.roll(x, -1, axis=-1)           # next-token targets
+    y[..., -1] = 0                        # last target is pad (no next token)
+    data = {"x": x, "y": y, "mask": np.ones((n, s), np.float32)}
+    model = hub.create("rnn", V, hidden=16, embed_dim=8)
+    t = TrainArgs(epochs=1, batch_size=8, learning_rate=0.5,
+                  extra={"task": "nwp"})
+    alg = make_fedavg(model.apply, t)
+    params = hub.init_params(model, (T,), jax.random.key(0), dtype=jnp.int32)
+    rnd = build_round_fn(alg, mesh=None)
+    st = alg.server_init(params, None)
+    losses = []
+    for r in range(6):
+        out = rnd(st, jnp.zeros((n,)),
+                  {k: jnp.asarray(v) for k, v in data.items()},
+                  jnp.arange(n), jnp.full((n,), float(s)),
+                  jax.random.fold_in(jax.random.key(5), r), None)
+        st = out.server_state
+        losses.append(float(out.metrics["train_loss"]))
+    assert losses[-1] < losses[0], losses
+
+
 # --------------------------------------------------------------------- FedGAN
 @pytest.mark.slow
 def test_fedgan_round_trains_both_networks():
