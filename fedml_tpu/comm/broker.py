@@ -39,6 +39,7 @@ class InMemoryBroker:
     def __init__(self):
         self._topics: dict[str, deque] = defaultdict(deque)
         self._blobs: dict[str, bytes] = {}
+        self._retained: dict[str, bytes] = {}
         self._cv = threading.Condition()
 
     # --- topic plane (MQTT)
@@ -46,6 +47,25 @@ class InMemoryBroker:
         with self._cv:
             self._topics[topic].append(frame)
             self._cv.notify_all()
+
+    # --- retained messages (MQTT retain flag: the broker keeps the LAST
+    # frame per topic and hands it to any later reader — last-value-wins,
+    # non-destructive reads; the publish/poll queues are unaffected). This
+    # is what makes broker-published artifacts observable by parties that
+    # attach after the publish (utils/artifacts.py BrokerArtifactStore).
+    def retain(self, topic: str, frame: bytes) -> None:
+        with self._cv:
+            self._retained[topic] = frame
+
+    def retained(self, topic: str) -> Optional[bytes]:
+        with self._cv:
+            return self._retained.get(topic)
+
+    def unretain(self, topic: str) -> None:
+        """Clear a retained frame (MQTT: publishing a zero-byte retained
+        message deletes the retained value)."""
+        with self._cv:
+            self._retained.pop(topic, None)
 
     def poll(self, topic: str, timeout: float = 0.2) -> Optional[bytes]:
         with self._cv:

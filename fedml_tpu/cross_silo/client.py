@@ -42,6 +42,11 @@ class FedClientManager:
     def _train_and_send(self, params, round_idx: int) -> None:
         with recorder.span("train", round=round_idx, client=self.client_id):
             new_params, n, metrics = self.trainer.train(params, round_idx)
+        # client-model publish on cadence (reference: core/mlops/__init__.py
+        # :475 log_client_model_info); no-op without an artifact store
+        from .. import mlops
+
+        mlops.log_client_model_info(round_idx, self.client_id, new_params)
         out = Message(md.C2S_SEND_MODEL, self.client_id, self.server_id)
         out.add(md.KEY_MODEL_PARAMS, new_params)
         out.add(md.KEY_NUM_SAMPLES, n)

@@ -239,6 +239,13 @@ class FedServerManager:
         self.params = self.aggregator.aggregate()
         if self.postprocess_agg_fn is not None:
             self.params = self.postprocess_agg_fn(self.params, self.round_idx)
+        # publish the round's aggregated model through the mlops artifact
+        # path (reference: fedml_aggregator calls mlops.log_aggregated_
+        # model_info every round, core/mlops/__init__.py:388); no-op unless
+        # an artifact store is configured
+        from .. import mlops
+
+        mlops.log_aggregated_model_info(self.round_idx, self.params)
         row = {"round": self.round_idx,
                "n_received": len(self.aggregator.results)}
         if self.eval_fn is not None:

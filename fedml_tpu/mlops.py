@@ -31,7 +31,7 @@ from .utils.events import recorder
 from .utils.sysperf import SysPerfMonitor
 
 _state: dict = {"sysperf": None, "log_handler": None, "events": {},
-                "sinks": [], "prev_root_level": None}
+                "sinks": [], "prev_root_level": None, "artifacts": None}
 
 
 def init(cfg, sysperf_interval: Optional[float] = None) -> None:
@@ -60,6 +60,31 @@ def init(cfg, sysperf_interval: Optional[float] = None) -> None:
         interval = sysperf_interval if sysperf_interval is not None else \
             float(t.extra.get("sysperf_interval", 10.0))
         _state["sysperf"] = SysPerfMonitor(interval).start()
+    # model-artifact store (reference: log_aggregated_model_info uploads to
+    # S3; here tracking_args.extra picks the sink):
+    #   artifact_store: "file" (default when artifact_dir set) | "broker"
+    #   artifact_dir:   file-store root
+    #   artifact_broker_id / artifact_keep_rounds: broker-store knobs
+    if _state["artifacts"] is None:
+        kind = t.extra.get("artifact_store")
+        if kind not in (None, "file", "broker"):
+            raise ValueError(
+                f"tracking_args.extra.artifact_store={kind!r}: choose "
+                "'file' or 'broker' (a typo here would silently disable "
+                "model-artifact publishing)")
+        if kind == "broker":
+            from .utils.artifacts import BrokerArtifactStore
+
+            _state["artifacts"] = BrokerArtifactStore(
+                broker_id=str(t.extra.get("artifact_broker_id", "default")),
+                run_id=str(t.run_name),
+                keep_rounds=t.extra.get("artifact_keep_rounds"))
+        elif kind == "file" or t.extra.get("artifact_dir"):
+            from .utils.artifacts import FileArtifactStore
+
+            root = t.extra.get("artifact_dir") or os.path.join(
+                t.log_file_dir, f"{t.run_name}_artifacts")
+            _state["artifacts"] = FileArtifactStore(root)
 
 
 def event(name: str, event_started: Optional[bool] = None,
@@ -92,6 +117,55 @@ def log_round_info(total_rounds: int, round_index: int) -> None:
     recorder.log({"round_index": round_index, "total_rounds": total_rounds})
 
 
+def set_artifact_store(store) -> None:
+    """Wire an artifact store directly (bypass config): any object with
+    put(name, tree) / get(name) / list() — utils/artifacts.py ships the
+    file- and broker-backed ones."""
+    _state["artifacts"] = store
+
+
+def artifact_store():
+    return _state["artifacts"]
+
+
+def log_aggregated_model_info(round_idx: int, model_params) -> None:
+    """Publish the round's aggregated global model (reference:
+    core/mlops/__init__.py:388 — uploaded every round; serving loads it
+    back). No-op when no artifact store is configured, like the reference
+    when tracking is off."""
+    store = _state["artifacts"]
+    if store is None:
+        return
+    from .utils.artifacts import aggregated_name
+
+    store.put(aggregated_name(round_idx), model_params)
+
+
+def log_client_model_info(round_idx: int, client_rank: int,
+                          model_params) -> None:
+    """Publish one client's locally-trained model (reference:
+    core/mlops/__init__.py:475 — client models on cadence)."""
+    store = _state["artifacts"]
+    if store is None:
+        return
+    from .utils.artifacts import client_name
+
+    store.put(client_name(round_idx, client_rank), model_params)
+
+
+def fetch_aggregated_model(round_idx: int):
+    """Collector side: load the round-N aggregated model back from the
+    artifact store (the reference fetches the S3 object by round)."""
+    store = _state["artifacts"]
+    if store is None:
+        raise RuntimeError("no artifact store configured — call mlops.init "
+                           "with tracking_args.extra.artifact_dir/"
+                           "artifact_store, or set_artifact_store()")
+    from .utils.artifacts import aggregated_name
+
+    return store.get(aggregated_name(round_idx))
+
+
 def system_stats() -> dict:
     from .utils.sysperf import sample_sysperf
 
@@ -117,3 +191,4 @@ def finish() -> None:
     if _state["prev_root_level"] is not None:
         root.setLevel(_state["prev_root_level"])
         _state["prev_root_level"] = None
+    _state["artifacts"] = None

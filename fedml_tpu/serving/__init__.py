@@ -20,8 +20,20 @@ from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
 __all__ = [
     "Predictor", "JaxPredictor", "GreedyLMPredictor",
     "FedMLInferenceRunner", "DEFAULT_PORT", "serve_simulator",
-    "predictor_from_checkpoint",
+    "predictor_from_checkpoint", "predictor_from_artifact",
 ]
+
+
+def predictor_from_artifact(store, round_idx: int,
+                            apply_fn: Callable) -> "JaxPredictor":
+    """Serve the round-N aggregated model published through the mlops
+    artifact path (reference shape: serving loads the S3 model the
+    aggregator uploaded with log_aggregated_model_info — core/mlops/
+    __init__.py:388). `store` is a utils/artifacts.py store (or anything
+    with .get(name))."""
+    from ..utils.artifacts import aggregated_name
+
+    return JaxPredictor(apply_fn, store.get(aggregated_name(round_idx)))
 
 
 def predictor_from_checkpoint(ckpt_dir: str, apply_fn: Callable,

@@ -1,0 +1,168 @@
+"""Model artifact stores — per-round aggregated/client model publishing.
+
+The reference uploads the aggregated model to S3 every round and client
+models on a cadence (reference: core/mlops/__init__.py:388
+`log_aggregated_model_info`, :475 `log_client_model_info`), and its serving
+path loads them back by round. This module is the TPU framework's local-first
+equivalent: the same verbs (exposed through `mlops.log_aggregated_model_info`
+/ `mlops.log_client_model_info`) write the comm layer's pickle-free tensor
+codec (comm/serialization.py) to one of two stores:
+
+- `FileArtifactStore`: a directory tree — the single-host / simulation sink.
+- `BrokerArtifactStore`: the broker's content-addressed blob plane
+  (comm/broker.py), with the name→blob-key index carried as MQTT-style
+  RETAINED messages, so a cross-silo observer that attaches mid-run (or a
+  serving process started after training) can fetch "round N" off-box —
+  the MQTT+S3 deployment shape.
+
+Artifacts are pytrees of arrays; `get` returns numpy-backed trees.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+from ..comm.serialization import decode, encode
+
+Pytree = Any
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9._/-]+$")
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name) or ".." in name or name.startswith("/"):
+        raise ValueError(
+            f"artifact name {name!r} must be a relative slash-path of "
+            "[A-Za-z0-9._-] segments")
+    return name
+
+
+def aggregated_name(round_idx: int) -> str:
+    return f"aggregated/round_{int(round_idx):06d}"
+
+
+def client_name(round_idx: int, client_rank: int) -> str:
+    return f"client_{int(client_rank)}/round_{int(round_idx):06d}"
+
+
+class FileArtifactStore:
+    """Directory-backed store: one codec blob per artifact name."""
+
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, name: str) -> Path:
+        return self.root / (_check_name(name) + ".bin")
+
+    def put(self, name: str, tree: Pytree) -> str:
+        p = self._path(name)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(".tmp")
+        tmp.write_bytes(encode(tree))
+        tmp.replace(p)          # atomic: a concurrent reader never sees a
+        return str(p)           # half-written artifact
+
+    def get(self, name: str) -> Pytree:
+        p = self._path(name)
+        if not p.exists():
+            raise KeyError(f"no artifact {name!r} under {self.root}")
+        return decode(p.read_bytes())
+
+    def list(self) -> list[str]:
+        return sorted(
+            str(f.relative_to(self.root))[: -len(".bin")]
+            for f in self.root.rglob("*.bin"))
+
+    def delete(self, name: str) -> None:
+        self._path(name).unlink(missing_ok=True)
+
+
+class BrokerArtifactStore:
+    """Broker-backed store: blobs on the content-addressed plane, the
+    name→key index as retained topic frames. Any process sharing the broker
+    id (same host here; same MQTT/S3 endpoints in a real deployment) sees
+    the same artifacts — publisher and fetcher construct this independently.
+
+    `keep_rounds` bounds the aggregated-model history: when set, publishing
+    round N drops rounds ≤ N - keep_rounds (their blobs are released from
+    the CAS refcount, so long runs don't pin every round's model in the
+    broker — the orphan-blob concern from the round-3 advisor).
+    """
+
+    _INDEX_TOPIC = "artifacts/_names"
+
+    def __init__(self, broker_id: str = "default", run_id: str = "default",
+                 keep_rounds: Optional[int] = None):
+        from ..comm.broker import get_cas_broker
+
+        self.broker = get_cas_broker(broker_id)
+        self.run_id = run_id
+        self.keep_rounds = keep_rounds
+        self._lock = threading.Lock()
+
+    def _topic(self, name: str) -> str:
+        return f"{self.run_id}/artifacts/{name}"
+
+    def _names(self) -> set[str]:
+        raw = self.broker.retained(f"{self.run_id}/{self._INDEX_TOPIC}")
+        return set(decode(raw)["names"]) if raw is not None else set()
+
+    def _write_names(self, names: set[str]) -> None:
+        self.broker.retain(f"{self.run_id}/{self._INDEX_TOPIC}",
+                           encode({"names": sorted(names)}))
+
+    def put(self, name: str, tree: Pytree) -> str:
+        _check_name(name)
+        key = self.broker.put_blob(encode(tree))
+        with self._lock:
+            old = self.broker.retained(self._topic(name))
+            self.broker.retain(self._topic(name), key.encode())
+            if old is not None:
+                # release the replaced artifact's ref — also when the new
+                # content hashes identically (put_blob's dedup hit bumped
+                # the refcount, so skipping this would pin the blob forever
+                # on republish-with-same-content runs)
+                try:
+                    self.broker.get_blob(old.decode(), delete=True)
+                except KeyError:
+                    pass
+            self._write_names(self._names() | {name})
+        if self.keep_rounds is not None:
+            self._prune(name)
+        return key
+
+    def get(self, name: str) -> Pytree:
+        raw = self.broker.retained(self._topic(_check_name(name)))
+        if raw is None:
+            raise KeyError(f"no artifact {name!r} on broker run "
+                           f"{self.run_id!r}")
+        return decode(self.broker.get_blob(raw.decode(), delete=False))
+
+    def list(self) -> list[str]:
+        return sorted(self._names())
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            raw = self.broker.retained(self._topic(name))
+            if raw is None:
+                return
+            self.broker.unretain(self._topic(name))
+            try:
+                self.broker.get_blob(raw.decode(), delete=True)
+            except KeyError:
+                pass
+            self._write_names(self._names() - {name})
+
+    def _prune(self, just_put: str) -> None:
+        m = re.match(r"^(.*/)round_(\d+)$", just_put)
+        if not m:
+            return
+        prefix, n = m.group(1), int(m.group(2))
+        cutoff = n - self.keep_rounds
+        for name in self.list():
+            pm = re.match(r"^(.*/)round_(\d+)$", name)
+            if pm and pm.group(1) == prefix and int(pm.group(2)) <= cutoff:
+                self.delete(name)

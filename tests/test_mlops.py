@@ -70,3 +70,158 @@ def test_mlops_facade_end_to_end(tmp_path):
 
 def test_system_stats_facade():
     assert mlops.system_stats()["rss_mb"] > 0
+
+
+# ------------------------------------------------- model artifact publishing
+def test_file_artifact_store_roundtrip(tmp_path):
+    import numpy as np
+
+    from fedml_tpu.utils.artifacts import FileArtifactStore, aggregated_name
+
+    store = FileArtifactStore(str(tmp_path / "arts"))
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": 1.5}
+    store.put(aggregated_name(2), tree)
+    back = store.get(aggregated_name(2))
+    assert np.allclose(back["w"], tree["w"]) and back["b"] == 1.5
+    assert store.list() == ["aggregated/round_000002"]
+    store.delete(aggregated_name(2))
+    assert store.list() == []
+    import pytest
+
+    with pytest.raises(ValueError):
+        store.put("../escape", tree)
+
+
+def test_broker_artifact_store_dedup_prune_and_cross_process_view():
+    """Blobs ride the content-addressed plane; the name index is MQTT-style
+    retained messages, so an independently-constructed store (another
+    process in a real deployment) sees the artifacts; keep_rounds releases
+    old rounds' blobs."""
+    import numpy as np
+
+    from fedml_tpu.comm.broker import get_cas_broker, release_broker
+    from fedml_tpu.utils.artifacts import BrokerArtifactStore, aggregated_name
+
+    bid = "arts-test"
+    try:
+        pub = BrokerArtifactStore(broker_id=bid, run_id="r1", keep_rounds=2)
+        for r in range(5):
+            pub.put(aggregated_name(r), {"w": np.full(4, float(r))})
+        # pruned to the last 2 rounds; old blobs released from the CAS
+        assert pub.list() == [aggregated_name(3), aggregated_name(4)]
+        assert len(get_cas_broker(bid)._blobs) == 2
+        # observer attaching AFTER the publishes still fetches round 4
+        obs = BrokerArtifactStore(broker_id=bid, run_id="r1")
+        assert np.allclose(obs.get(aggregated_name(4))["w"], 4.0)
+        # non-destructive reads: fetch twice
+        assert np.allclose(obs.get(aggregated_name(4))["w"], 4.0)
+    finally:
+        release_broker(bid)
+
+
+def test_cross_silo_publishes_round_models_and_serving_loads_them(tmp_path):
+    """VERDICT r3 item 3 done-condition: run 3 federated rounds over the
+    comm layer, fetch the round-2 aggregated model via the collector, and
+    serve it (reference: core/mlops/__init__.py:388 + serving load-back)."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.comm import FedCommManager
+    from fedml_tpu.comm.loopback import LoopbackTransport
+    from fedml_tpu.config import TrainArgs
+    from fedml_tpu.cross_silo import (
+        FedClientManager, FedServerManager, SiloTrainer,
+    )
+    from fedml_tpu.models import hub
+    from fedml_tpu.serving import predictor_from_artifact, FedMLInferenceRunner
+    from fedml_tpu.utils.artifacts import FileArtifactStore, client_name
+
+    store = FileArtifactStore(str(tmp_path / "arts"))
+    mlops.set_artifact_store(store)
+    try:
+        run_id = "cs-arts"
+        model = hub.create("lr", 3)
+        t = TrainArgs(epochs=2, batch_size=16, learning_rate=0.3,
+                      client_num_in_total=2, client_num_per_round=2,
+                      comm_round=3)
+        params = jax.tree.map(
+            np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+        rs = np.random.RandomState(0)
+        w_true = rs.randn(8, 3)
+        trainers = []
+        for cid in (1, 2):
+            x = rs.randn(64, 8).astype(np.float32)
+            y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+            trainers.append(SiloTrainer(model.apply, t, x, y, seed=cid))
+        server = FedServerManager(
+            FedCommManager(LoopbackTransport(0, run_id), 0),
+            client_ids=[1, 2], init_params=params, num_rounds=3)
+        clients = [
+            FedClientManager(
+                FedCommManager(LoopbackTransport(cid, run_id), cid),
+                cid, trainers[i])
+            for i, cid in enumerate((1, 2))]
+        server.run(background=True)
+        for c in clients:
+            c.run(background=True)
+        for c in clients:
+            c.announce_ready()
+        assert server.done.wait(timeout=120)
+
+        # every round's aggregated model was published, plus client models
+        names = store.list()
+        for r in range(3):
+            assert f"aggregated/round_{r:06d}" in names
+        assert client_name(0, 1) in names and client_name(0, 2) in names
+
+        # collector: fetch round-2 (the model BEFORE the final aggregate
+        # replaced it in server.params would be round<2; round 2 is final
+        # here) and serve it over HTTP
+        fetched = mlops.fetch_aggregated_model(2)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=0),
+                     fetched, server.params)
+        pred = predictor_from_artifact(store, 2, model.apply)
+        runner = FedMLInferenceRunner(pred, host="127.0.0.1", port=0)
+        runner.start()
+        try:
+            x = rs.randn(4, 8).astype(np.float32)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{runner.port}/predict",
+                data=json.dumps({"inputs": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+            served = np.asarray(out["predictions"])
+            direct = model.apply(
+                {"params": jax.tree.map(jnp.asarray, fetched)}, jnp.asarray(x))
+            # the predictor serves argmax class ids
+            np.testing.assert_array_equal(
+                served, np.argmax(np.asarray(direct), -1))
+        finally:
+            runner.stop()
+    finally:
+        mlops.set_artifact_store(None)
+
+
+def test_broker_artifact_republish_same_content_no_blob_leak():
+    """Republishing a name with identical content must not pin the blob:
+    put_blob's dedup hit bumps the CAS refcount, and put releases the
+    replaced ref even when old==new key."""
+    import numpy as np
+
+    from fedml_tpu.comm.broker import get_cas_broker, release_broker
+    from fedml_tpu.utils.artifacts import BrokerArtifactStore, aggregated_name
+
+    bid = "arts-leak"
+    try:
+        st = BrokerArtifactStore(broker_id=bid, run_id="r")
+        tree = {"w": np.ones(3, np.float32)}
+        st.put(aggregated_name(0), tree)
+        st.put(aggregated_name(0), tree)          # identical content
+        st.delete(aggregated_name(0))
+        assert get_cas_broker(bid)._blobs == {}   # nothing pinned
+        assert st.list() == []
+    finally:
+        release_broker(bid)
