@@ -167,6 +167,11 @@ class Config:
     rank: int = 0
     role: str = "server"
     run_id: str = "0"
+    # per-client override config (reference: __init__.py:188-214
+    # _update_client_specific_args — a `client_specific_args` YAML section
+    # whose `data_silo_config` lists one override YAML per client rank;
+    # rank r>0 merges file [r-1] over its base config)
+    client_specific_args: dict = field(default_factory=dict)
 
     SECTION_TYPES = {
         "common_args": CommonArgs,
@@ -190,6 +195,8 @@ class Config:
         for k in ("rank", "role", "run_id"):
             if k in d:
                 setattr(cfg, k, d[k])
+        if isinstance(d.get("client_specific_args"), dict):
+            cfg.client_specific_args = dict(d["client_specific_args"])
         cfg.validate()
         return cfg
 
@@ -208,6 +215,46 @@ class Config:
         out.update(rank=self.rank, role=self.role, run_id=self.run_id)
         return out
 
+    def merge_overrides(self, d: dict) -> None:
+        """Merge a (possibly partial) config dict over this config: known
+        section dicts merge into their sections. Flat keys (the reference's
+        attr-bag style — arguments.py set_attr_from_config sets everything
+        flat) route to whichever section declares that field (so a flat
+        `data_cache_dir` reaches data_args, `model` reaches model_args);
+        undeclared flat keys default to train_args.extra. Re-validates
+        after the merge."""
+        for k, v in d.items():
+            if k in self.SECTION_TYPES and isinstance(v, dict):
+                _apply(getattr(self, k), v)
+            elif k in ("rank", "role", "run_id"):
+                setattr(self, k, v)
+            else:
+                _apply(getattr(self, _FLAT_KEY_SECTION.get(k, "train_args")),
+                       {k: v})
+        self.validate()
+
+    def apply_data_silo_config(self, base_dir: Optional[Path] = None) -> None:
+        """Per-client config overrides (reference: python/fedml/__init__.py
+        :188-214 `_update_client_specific_args`): when
+        `client_specific_args.data_silo_config` lists override YAMLs and this
+        config's rank is a client rank (>0), merge file [rank-1] over the
+        base config. Paths resolve against `base_dir` (the main config
+        file's directory) first, then cwd."""
+        silo_cfgs = (self.client_specific_args.get("data_silo_config")
+                     or self.train_args.extra.get("data_silo_config"))
+        if not silo_cfgs or self.rank <= 0:
+            return
+        if self.rank > len(silo_cfgs):
+            raise ValueError(
+                f"rank {self.rank} has no data_silo_config entry "
+                f"({len(silo_cfgs)} files listed)")
+        p = Path(str(silo_cfgs[self.rank - 1])).expanduser()
+        if not p.is_absolute() and base_dir is not None \
+                and (Path(base_dir) / p).exists():
+            p = Path(base_dir) / p
+        with open(p) as f:
+            self.merge_overrides(yaml.safe_load(f) or {})
+
     def validate(self) -> None:
         t = self.train_args
         if t.client_num_per_round > t.client_num_in_total:
@@ -225,6 +272,18 @@ class Config:
             TRAINING_TYPE_CENTRALIZED,
         ):
             raise ValueError(f"unknown training_type {self.common_args.training_type!r}")
+
+
+# flat override key -> owning section, for reference-style flat silo
+# overrides (train_args listed first so its names win any collision, which
+# preserves the common case: batch_size/learning_rate/... are train knobs)
+_FLAT_KEY_SECTION: dict = {}
+for _section in ("dp_args", "security_args", "tracking_args", "comm_args",
+                 "device_args", "validation_args", "model_args", "data_args",
+                 "common_args", "train_args"):
+    for _f in dataclasses.fields(Config.SECTION_TYPES[_section]):
+        if _f.name != "extra":
+            _FLAT_KEY_SECTION[_f.name] = _section
 
 
 def load_config(path: str | Path) -> Config:

@@ -1,0 +1,87 @@
+"""Per-client (data-silo) config overrides — reference:
+python/fedml/__init__.py:188-214 `_update_client_specific_args` +
+arguments.py `data_silo_config`: a `client_specific_args` section lists one
+override YAML per client rank; client rank r merges file [r-1] over the base
+config."""
+import pytest
+import yaml
+
+import fedml_tpu
+
+
+def _write_configs(tmp_path):
+    (tmp_path / "silo_1.yaml").write_text(yaml.safe_dump({
+        "train_args": {"batch_size": 8, "learning_rate": 0.5}}))
+    # silo 2 uses the reference's FLAT key style (attr-bag sets them flat)
+    (tmp_path / "silo_2.yaml").write_text(yaml.safe_dump({
+        "batch_size": 64}))
+    base = {
+        "common_args": {"training_type": "cross_silo"},
+        "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                       "batch_size": 32, "learning_rate": 0.1},
+        "client_specific_args": {
+            "data_silo_config": ["silo_1.yaml", "silo_2.yaml"]},
+    }
+    p = tmp_path / "fedml_config.yaml"
+    p.write_text(yaml.safe_dump(base))
+    return p
+
+
+def test_two_silos_get_different_batch_sizes(tmp_path):
+    p = _write_configs(tmp_path)
+    c1 = fedml_tpu.init(config_path=str(p), rank=1, role="client")
+    assert c1.train_args.batch_size == 8
+    assert c1.train_args.learning_rate == 0.5
+    c2 = fedml_tpu.init(config_path=str(p), rank=2, role="client")
+    assert c2.train_args.batch_size == 64
+    assert c2.train_args.learning_rate == 0.1   # untouched by silo_2.yaml
+
+
+def test_server_rank_keeps_base_config(tmp_path):
+    p = _write_configs(tmp_path)
+    c0 = fedml_tpu.init(config_path=str(p))
+    assert c0.rank == 0
+    assert c0.train_args.batch_size == 32
+
+
+def test_rank_beyond_silo_list_raises(tmp_path):
+    p = _write_configs(tmp_path)
+    with pytest.raises(ValueError, match="no data_silo_config entry"):
+        fedml_tpu.init(config_path=str(p), rank=3)
+
+
+def test_data_silo_config_in_train_args_extra(tmp_path):
+    """The list may also live in train_args (unknown keys land in extra) —
+    the flat attr-bag location the reference reads."""
+    (tmp_path / "s1.yaml").write_text(yaml.safe_dump({"epochs": 7}))
+    cfg = fedml_tpu.init(config={
+        "train_args": {"data_silo_config": [str(tmp_path / "s1.yaml")]},
+        "rank": 1,
+    })
+    assert cfg.train_args.epochs == 7
+
+
+def test_override_cannot_break_validation(tmp_path):
+    (tmp_path / "bad.yaml").write_text(yaml.safe_dump(
+        {"train_args": {"client_num_per_round": 99}}))
+    with pytest.raises(ValueError, match="client_num_per_round"):
+        fedml_tpu.init(config={
+            "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                           "data_silo_config": [str(tmp_path / "bad.yaml")]},
+            "rank": 1,
+        })
+
+
+def test_flat_override_keys_route_to_owning_section(tmp_path):
+    """Reference-style FLAT overrides must reach the section that owns the
+    field: data_cache_dir -> data_args (the canonical per-silo data path),
+    model -> model_args, batch_size -> train_args."""
+    (tmp_path / "s1.yaml").write_text(yaml.safe_dump({
+        "data_cache_dir": "/silo1/data", "model": "cnn", "batch_size": 4}))
+    cfg = fedml_tpu.init(config={
+        "train_args": {"data_silo_config": [str(tmp_path / "s1.yaml")]},
+        "rank": 1,
+    })
+    assert cfg.data_args.data_cache_dir == "/silo1/data"
+    assert cfg.model_args.model == "cnn"
+    assert cfg.train_args.batch_size == 4
