@@ -40,7 +40,26 @@ DATASET_SHAPES = {
     "fed_shakespeare": ((80,), 90),          # CHAR_VOCAB + pad/bos/eos/oov
     "stackoverflow_nwp": ((20,), 10004),     # 10k words + 4 special ids
     "stackoverflow_lr": ((10000,), 500),     # BoW in, 500 multi-hot tags out
+    # folder-image / CSV-mapped formats (data/folder_csv.py; reference:
+    # data_loader.py:375-446). Synthetic-fallback shapes are downscaled for
+    # the image sets (real folder data loads at native/configured size).
+    "ILSVRC2012": ((64, 64, 3), 1000),
+    "imagenet": ((64, 64, 3), 1000),         # alias, same folder format
+    "gld23k": ((64, 64, 3), 203),
+    "gld160k": ((64, 64, 3), 2028),
+    # tabular-CSV sets (reference: data/UCI, data/lending_club_loan,
+    # data/NUS_WIDE — feature widths per their readers)
+    "SUSY": ((18,), 2),
+    "room_occupancy": ((5,), 2),
+    "lending_club": ((90,), 2),
+    "nus_wide": ((634,), 5),
 }
+
+# datasets served by the folder-image / landmarks-CSV / tabular-CSV format
+# loaders (data/folder_csv.py)
+_FOLDER_IMAGE = {"ILSVRC2012", "imagenet", "cinic10"}
+_LANDMARKS = {"gld23k", "gld160k"}
+_TABULAR = {"SUSY", "room_occupancy", "lending_club", "nus_wide"}
 
 # token-sequence NWP tasks: synthetic fallback generates [N, T] int x with
 # per-position next-token targets instead of Gaussian feature vectors
@@ -358,6 +377,15 @@ def _make_named_loader(name: str):
             from . import tff_h5
 
             ds = getattr(tff_h5, name)(cache, cfg)
+            if ds is not None:
+                return ds
+        if name in _FOLDER_IMAGE or name in _LANDMARKS or name in _TABULAR:
+            from . import folder_csv
+
+            fn = (folder_csv.folder_image if name in _FOLDER_IMAGE else
+                  folder_csv.landmarks_csv if name in _LANDMARKS else
+                  folder_csv.tabular_csv)
+            ds = fn(name, cache, cfg)
             if ds is not None:
                 return ds
         ds = _npz_dataset(name, cache, cfg)

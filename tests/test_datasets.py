@@ -151,3 +151,142 @@ def test_mesh_mapping_file(tmp_path):
     assert sim.mesh is not None and sim.mesh.axis_names == ("clients",)
     m = sim.run_round(0)
     assert np.isfinite(m["train_loss"])
+
+
+# -------------------------------------- folder-image / CSV formats (r4)
+def _png(path, rs, shape=(16, 16, 3)):
+    from PIL import Image
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(rs.randint(0, 255, shape, dtype=np.uint8)).save(path)
+
+
+def test_imagenet_folder_format(tmp_path):
+    """ImageNet-style class-folder tree round-trips (reference:
+    data/ImageNet/data_loader.py ImageFolder semantics)."""
+    rs = np.random.RandomState(0)
+    root = tmp_path / "ILSVRC2012"
+    for split, per in (("train", 6), ("val", 2)):
+        for cname in ("n01", "n02", "n03"):
+            for i in range(per):
+                _png(root / split / cname / f"{i}.png", rs)
+    cfg = _cfg("ILSVRC2012", tmp_path, client_num_in_total=2,
+               client_num_per_round=2)
+    ds = dl.load(cfg)
+    assert not getattr(ds, "synthetic", False)
+    assert ds.num_classes == 3
+    assert ds.num_clients == 2
+    assert ds.x_train.shape[2:] == (16, 16, 3)
+    assert ds.x_test.shape[0] == 6          # 3 classes x 2 val images
+    assert 0.0 <= ds.x_train.max() <= 1.0
+
+
+def test_imagenet_folder_mixed_shapes_need_image_size(tmp_path):
+    rs = np.random.RandomState(1)
+    root = tmp_path / "ILSVRC2012"
+    _png(root / "train" / "a" / "0.png", rs, (16, 16, 3))
+    _png(root / "train" / "a" / "1.png", rs, (20, 20, 3))
+    _png(root / "train" / "b" / "0.png", rs, (16, 16, 3))
+    cfg = _cfg("ILSVRC2012", tmp_path, client_num_in_total=1,
+               client_num_per_round=1)
+    with pytest.raises(ValueError, match="image_size"):
+        dl.load(cfg)
+    cfg.data_args.extra["image_size"] = 16
+    ds = dl.load(cfg)
+    assert ds.x_train.shape[2:] == (16, 16, 3)
+
+
+def test_landmarks_gld23k_csv_format(tmp_path):
+    """gld23k mapping-CSV format: user_id/image_id/class rows, images at
+    <cache>/images/<image_id>.jpg, one client per user (reference:
+    data/Landmarks/data_loader.py:123-148, datasets.py:51)."""
+    import csv
+
+    rs = np.random.RandomState(2)
+    rows = [("u_a", "0/aa", 0), ("u_a", "0/ab", 1), ("u_b", "1/ba", 1),
+            ("u_b", "1/bb", 2), ("u_b", "1/bc", 0)]
+    for _u, img, _c in rows:
+        _png(tmp_path / "images" / f"{img}.jpg", rs)
+    with open(tmp_path / "mini_gld_train_split.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["user_id", "image_id", "class"])
+        w.writerows(rows)
+    with open(tmp_path / "mini_gld_test.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["user_id", "image_id", "class"])
+        w.writerow(["t", "0/aa", 2])
+    cfg = _cfg("gld23k", tmp_path, client_num_in_total=2,
+               client_num_per_round=2, batch_size=2)
+    ds = dl.load(cfg)
+    assert not getattr(ds, "synthetic", False)
+    assert ds.num_clients == 2
+    assert list(ds.counts) == [2, 3]        # natural per-user partition
+    assert ds.num_classes == 3
+    assert ds.x_test.shape[0] == 1
+
+
+def test_tabular_csv_format(tmp_path):
+    """UCI/lending_club-style tabular CSV: header + label column, features
+    standardized, 80/20 split (reference: data/UCI, lending_club_dataset.py)."""
+    rs = np.random.RandomState(3)
+    n = 60
+    x = rs.randn(n, 18) * 5 + 3
+    y = (x[:, 0] > 3).astype(int)
+    lines = ["f" + ",f".join(map(str, range(18))) + ",label"]
+    for i in range(n):
+        lines.append(",".join(f"{v:.4f}" for v in x[i]) + f",{y[i]}")
+    (tmp_path / "SUSY.csv").write_text("\n".join(lines))
+    cfg = _cfg("SUSY", tmp_path, client_num_in_total=3, client_num_per_round=3)
+    ds = dl.load(cfg)
+    assert not getattr(ds, "synthetic", False)
+    assert ds.num_classes == 2
+    assert ds.x_train.shape[-1] == 18
+    assert ds.x_test.shape[0] == 12         # 20% holdout
+    # standardized: feature means near 0 over train+test pool
+    pooled = np.concatenate([
+        np.asarray(ds.x_train).reshape(-1, 18)[
+            np.asarray(ds.mask_train).reshape(-1) > 0],
+        np.asarray(ds.x_test)])
+    assert abs(pooled.mean()) < 0.2
+    # synthetic fallback still honors the format's shape when files absent
+    cfg2 = _cfg("SUSY", tmp_path / "nope", client_num_in_total=3,
+                client_num_per_round=3)
+    ds2 = dl.load(cfg2)
+    assert ds2.synthetic and ds2.x_train.shape[-1] == 18
+
+
+def test_landmarks_fewer_users_than_clients_raises(tmp_path):
+    import csv
+
+    rs = np.random.RandomState(5)
+    _png(tmp_path / "images" / "only.jpg", rs)
+    with open(tmp_path / "mini_gld_train_split.csv", "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["user_id", "image_id", "class"])
+        w.writerow(["solo", "only", 0])
+    cfg = _cfg("gld23k", tmp_path, client_num_in_total=3,
+               client_num_per_round=3)
+    with pytest.raises(ValueError, match="1 users"):
+        dl.load(cfg)
+
+
+def test_tabular_holdout_only_class_widens_head(tmp_path):
+    """num_classes covers classes that land entirely in the 20% holdout."""
+    rs = np.random.RandomState(3)
+    # seed-0 permutation of 20 rows puts specific indices in the holdout;
+    # rather than chase them, give class 2 to EVERY index the split can
+    # pick: 4 holdout rows of a 20-row file -> try all seeds? Simpler:
+    # construct so class 2 appears ONCE and check num_classes is 3 even if
+    # that row lands in the holdout for this seed.
+    n = 20
+    x = rs.randn(n, 4)
+    y = np.zeros(n, int)
+    y[1::2] = 1
+    y[7] = 2                      # single class-2 row
+    lines = ["a,b,c,d,label"]
+    lines += [",".join(f"{v:.3f}" for v in x[i]) + f",{y[i]}"
+              for i in range(n)]
+    (tmp_path / "SUSY.csv").write_text("\n".join(lines))
+    cfg = _cfg("SUSY", tmp_path, client_num_in_total=2, client_num_per_round=2)
+    ds = dl.load(cfg)
+    assert ds.num_classes == 3
