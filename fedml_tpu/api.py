@@ -93,8 +93,18 @@ def launch_job(job: dict | str, cluster: Optional[LocalCluster] = None,
                wait: bool = False, timeout: float = 600.0):
     """reference: api launch_job(yaml) -> submits to the Launch platform.
     Here: submit a scheduler job spec (dict, or path to a yaml) to a
-    LocalCluster's master. Returns the job id (and the result when
-    wait=True)."""
+    LocalCluster's master.
+
+    Returns, by argument combination:
+    - ``cluster`` given, ``wait=False`` -> the job id (str). The cluster
+      stays yours.
+    - ``cluster`` given, ``wait=True``  -> ``{"job_id", "status", "result"}``.
+    - ``cluster=None``, ``wait=True``   -> same dict; a throwaway cluster is
+      created and stopped internally.
+    - ``cluster=None``, ``wait=False``  -> ``(job_id, cluster)``: the
+      auto-created cluster is returned because the CALLER owns it — keep it
+      to poll/wait and call ``cluster.stop()`` (or ``cluster_stop``) when
+      done, or it leaks its worker threads."""
     import yaml
 
     if isinstance(job, str):

@@ -64,8 +64,13 @@ def _count(jaxpr) -> float:
         elif name == "scan":
             total += eqn.params["length"] * _count(eqn.params["jaxpr"].jaxpr)
         elif name == "while":
-            # trip count is data-dependent; count one iteration (lower bound)
-            total += _count(eqn.params["body_jaxpr"].jaxpr)
+            # trip count is data-dependent AND may be zero, so the only
+            # count that keeps the strict-lower-bound invariant exact is 0
+            # iterations (round-3 advisor: counting one body iteration
+            # could overcount a zero-trip loop). The framework's hot loops
+            # are all lax.scan (statically counted above); while_loops in
+            # round programs are control scaffolding, not FLOP carriers.
+            pass
         elif name == "cond":
             # min over branches: the executed branch is unknown at trace
             # time, and only min preserves the strict-lower-bound guarantee

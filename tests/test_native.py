@@ -180,7 +180,10 @@ def test_native_cnn_trainer_matches_flax_gradients():
         jax.tree.map(lambda a, g: a - 0.1 * g, params, grads)
     ).astype(np.float32)
     assert abs(m["train_loss"] - float(loss)) < 1e-3
-    np.testing.assert_allclose(out, ref, atol=5e-4)
+    # measured max |delta| is ~3e-8 on CPU; 1e-6 leaves platform headroom
+    # while actually enforcing the README/COVERAGE precision claim
+    # (round-3 advisor: the old 5e-4 bound enforced nothing)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
 
 
 def test_native_cnn_trainer_learns_digits():
