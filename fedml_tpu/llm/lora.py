@@ -3,8 +3,10 @@
 The reference's FedLLM uses HF peft LoRA on torch modules (reference:
 python/spotlight_prj/fedllm/README.md:1). TPU design: no module surgery —
 LoRA is a *parameter-space* transform. `lora_init` walks the params pytree
-and creates (A, B) factors for every 2-D kernel whose path matches the
-target filter; `lora_merge` produces effective weights W + (alpha/r)·A@B
+and creates (A, B) factors for every kernel whose path matches the target
+filter — 2-D [din, dout], or 3-D [L, din, dout] when the base stacks block
+weights (TransformerLM(scan_layers=True)), where the adapters carry the
+same leading layer axis; `lora_merge` produces effective weights W + (alpha/r)·A@B
 inside the traced step, so autodiff w.r.t. the adapters flows through the
 merge while the base stays a constant. XLA fuses the rank-r update into the
 consuming matmul's epilogue — no runtime module wrapper needed.
@@ -38,26 +40,29 @@ def lora_init(rng: jax.Array, params: Pytree, rank: int = 8,
               targets: Sequence[str] = ("wq", "wk", "wv", "wo"),
               a_std: float = 0.01) -> dict:
     """Create the adapter pytree: {path_str: {"a": [din, r], "b": [r, dout]}}
-    for every 2-D `kernel` leaf whose path contains one of `targets`.
+    for every `kernel` leaf whose path contains one of `targets`.
     B is zero-initialized (standard LoRA: the merged model starts exactly at
-    the base model); A is small-normal."""
+    the base model); A is small-normal. Scan-over-layers bases
+    (TransformerLM(scan_layers=True)) stack block kernels [L, din, dout];
+    their adapters get the same leading axis ([L, din, r] / [L, r, dout]) —
+    a per-layer adapter pair, matmul-broadcast through the merge."""
     flat, _ = _paths_and_leaves(params)
     adapters = {}
     keys = jax.random.split(rng, max(1, len(flat)))
     for i, (path, leaf) in enumerate(flat):
         ps = _path_str(path)
-        if leaf.ndim == 2 and ps.endswith("kernel") and any(
+        if leaf.ndim in (2, 3) and ps.endswith("kernel") and any(
                 t in ps for t in targets):
-            din, dout = leaf.shape
+            *stack, din, dout = leaf.shape
             adapters[ps] = {
-                "a": a_std * jax.random.normal(keys[i], (din, rank),
-                                               jnp.float32),
-                "b": jnp.zeros((rank, dout), jnp.float32),
+                "a": a_std * jax.random.normal(
+                    keys[i], (*stack, din, rank), jnp.float32),
+                "b": jnp.zeros((*stack, rank, dout), jnp.float32),
             }
     if not adapters:
         raise ValueError(
             f"no kernels matched LoRA targets {list(targets)}; available: "
-            f"{[_path_str(p) for p, l in flat if l.ndim == 2][:10]}")
+            f"{[_path_str(p) for p, l in flat if l.ndim in (2, 3)][:10]}")
     return adapters
 
 
@@ -68,7 +73,7 @@ def lora_merge(base_params: Pytree, adapters: dict, alpha: float = 16.0,
     consumer."""
     if not adapters:
         return base_params
-    rank = next(iter(adapters.values()))["a"].shape[1]
+    rank = next(iter(adapters.values()))["a"].shape[-1]
     scale = alpha / rank
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(base_params)

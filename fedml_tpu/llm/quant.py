@@ -1,0 +1,117 @@
+"""Int8 weight quantization for frozen LoRA bases (the QLoRA shape).
+
+BASELINE workload 5 is federated LoRA over LLaMA-2-7B; a bf16 7B base is
+14 GB — over half a 16 GB v5e HBM before activations. Since federated LoRA
+never updates the base (clients exchange adapters only — llm/lora.py), the
+base can be STORED int8 (≈7 GB) and dequantized to bf16 on the fly inside
+the jitted step. Each dequantized weight is consumed by exactly one block,
+so XLA's buffer liveness keeps only ~one block's bf16 weights resident at a
+time; with per-block remat the backward pass re-dequantizes instead of
+saving. Peak HBM ≈ int8 base + one block bf16 + activation checkpoints.
+
+Scheme: symmetric per-output-channel int8 (scale = max|w| / 127 over all
+axes but the last). Small/1-D leaves (norm scales, biases) stay bf16 — they
+are HBM-negligible and precision-critical. This is a storage format, not a
+compute format: matmuls still run bf16 on the MXU (int8 matmul would change
+numerics; the MXU win here is memory, which is the actual 7B bottleneck).
+
+No reference equivalent — the reference's FedLLM (spotlight_prj/fedllm)
+inherits HF peft/bitsandbytes for this; on TPU the transform is ~60 lines
+of pytree surgery.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+_MIN_QUANT_SIZE = 4096   # leaves smaller than this stay bf16
+
+
+def quantize_tree_int8(params: Pytree) -> Pytree:
+    """Replace every large float leaf with {"q": int8, "s": f32 scales}.
+    Structure is preserved; dequantize_tree inverts."""
+
+    def one(leaf):
+        if leaf.ndim < 2 or leaf.size < _MIN_QUANT_SIZE or \
+                not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return jnp.asarray(leaf, jnp.bfloat16)
+        w = leaf.astype(jnp.float32)
+        # per-out-channel scales: reduce all axes but the last — except for
+        # 3-D stacked scan-layer kernels [L, din, dout], which keep their
+        # leading layer axis so every layer gets its own channel scales
+        red = (1,) if w.ndim == 3 else tuple(range(w.ndim - 1))
+        s = jnp.max(jnp.abs(w), axis=red, keepdims=True) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+        return {"q": q, "s": s}
+
+    return jax.tree.map(one, params)
+
+
+def _is_q(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
+
+
+def dequant_leaf(leaf, dtype=jnp.bfloat16):
+    if _is_q(leaf):
+        return (leaf["q"].astype(jnp.float32) * leaf["s"]).astype(dtype)
+    return leaf
+
+
+def dequantize_tree(qparams: Pytree, dtype=jnp.bfloat16) -> Pytree:
+    """bf16 view of a quantized tree (inside jit: XLA fuses the dequant into
+    each consumer and frees per-block buffers after use)."""
+    return jax.tree.map(lambda l: dequant_leaf(l, dtype), qparams,
+                        is_leaf=_is_q)
+
+
+def quant_bytes(qparams: Pytree) -> int:
+    """Actual storage footprint of the quantized tree (the HBM-budget
+    number bench reports)."""
+    total = 0
+    for leaf in jax.tree.leaves(qparams):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def synth_quantized_base(rng: jax.Array, shapes: Pytree) -> Pytree:
+    """Random int8 base matching a `jax.eval_shape` tree — for memory and
+    throughput probes (bench 7B ceiling) where weight VALUES don't matter
+    but the full HBM footprint and matmul shapes must be real. Building
+    int8 directly avoids ever materializing the f32/bf16 init (a 7B f32
+    init is 28 GB — it could never be quantized after the fact on a 16 GB
+    chip). Same quantize/passthrough rule as quantize_tree_int8."""
+    leaves, treedef = jax.tree_util.tree_flatten(shapes)
+    keys = jax.random.split(rng, max(1, len(leaves)))
+
+    def build(i, sd):
+        if sd.ndim < 2 or sd.size < _MIN_QUANT_SIZE or \
+                not jnp.issubdtype(sd.dtype, jnp.floating):
+            return 0.02 * jax.random.normal(keys[i], sd.shape, jnp.bfloat16)
+        q = jax.random.randint(keys[i], sd.shape, -127, 128, jnp.int8)
+        fan_in = sd.shape[-2] if sd.ndim > 1 else sd.shape[0]
+        s = jnp.full(tuple(1 for _ in sd.shape[:-1]) + sd.shape[-1:],
+                     (3.0 / max(fan_in, 1)) ** 0.5 / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [build(i, sd) for i, sd in enumerate(leaves)])
+
+
+def lora_apply_fn_quant(apply_fn, qbase: Pytree, alpha: float = 16.0):
+    """lora.lora_apply_fn over an int8 base: dequantize + merge adapters
+    inside the traced step. Gradients flow only to the adapters (the
+    dequantized base is a constant w.r.t. them)."""
+    from .lora import lora_merge
+
+    def wrapped(variables, x, *args, **kwargs):
+        base = dequantize_tree(qbase)
+        merged = lora_merge(base, variables["params"], alpha)
+        return apply_fn({"params": merged}, x, *args, **kwargs)
+
+    return wrapped

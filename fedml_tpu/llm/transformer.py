@@ -97,14 +97,35 @@ class TransformerLM(nn.Module):
     # O(layers x B x T x D) -> O(B x T x D) activation memory — what lets a
     # >=1B-param base train at T=2048 on one chip (SURVEY §5.7 remat note)
     remat: bool = False
+    # scan-over-layers: compile ONE block and lax.scan it, with block params
+    # stacked on a leading [n_layers] axis (`blocks/...: [L, ...]`). The HLO
+    # is O(1) in depth instead of O(L) — a 32-layer d4096 model unrolled is
+    # too big for some compile services (observed: the remote-compile helper
+    # 500s on unrolled LLaMA-7B-shape while L=4 compiles fine), and compile
+    # time drops ~L-fold. Combines with `remat` (checkpoint per scanned
+    # step = the flax remat_scan pattern). llm/lora.py and llm/quant.py
+    # both understand the stacked [L, din, dout] kernel layout.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, pos_offset=0):
         pos = pos_offset + jnp.arange(tokens.shape[1])
         x = nn.Embed(self.vocab_size, self.d_model, name="embed")(tokens)
-        block_cls = nn.remat(Block) if self.remat else Block
-        for i in range(self.n_layers):
-            x = block_cls(self.n_heads, self.d_ff, self.attn_fn,
-                          name=f"block_{i}")(x, pos)
+        if self.scan_layers:
+            block = Block
+            if self.remat:
+                block = nn.remat(block, prevent_cse=False)
+            x, _ = nn.scan(
+                lambda mdl, carry, _xs: (mdl(carry, pos), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=self.n_layers,
+            )(block(self.n_heads, self.d_ff, self.attn_fn, name="blocks"),
+              x, None)
+        else:
+            block_cls = nn.remat(Block) if self.remat else Block
+            for i in range(self.n_layers):
+                x = block_cls(self.n_heads, self.d_ff, self.attn_fn,
+                              name=f"block_{i}")(x, pos)
         x = RMSNorm(name="final_norm")(x)
         return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)

@@ -322,6 +322,22 @@ class Simulator:
                 row.update(self.evaluate())
             recorder.log(row)
             self.history.append(row)
+            # per-round aggregated-model publish (reference: the aggregator
+            # calls mlops.log_aggregated_model_info every round —
+            # core/mlops/__init__.py:388); no-op unless an artifact store
+            # is configured via mlops.init/set_artifact_store. Degrade,
+            # don't die: like the telemetry sinks, a store hiccup must not
+            # kill a long training run
+            from .. import mlops
+
+            try:
+                mlops.log_aggregated_model_info(r, self.server_state.params)
+            except Exception as e:  # noqa: BLE001
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "round-%d model-artifact publish failed (continuing): "
+                    "%s: %s", r, type(e).__name__, e)
             if checkpoint_dir is not None and checkpoint_every and (
                 (r + 1) % checkpoint_every == 0 or r == rounds - 1
             ):

@@ -69,3 +69,130 @@ def test_base_sharded_checkpoint_roundtrip(tmp_path):
     # restored leaves land TP-sharded, not replicated
     flat = jax.tree.leaves(got)
     assert any("tp" in str(l.sharding.spec) for l in flat)
+
+
+# ------------------------------------------------ int8 frozen base (QLoRA)
+def test_int8_base_quant_roundtrip_and_lora_training():
+    """llm/quant.py: per-channel int8 storage of the frozen base — dequant
+    error bounded by the per-channel step, adapters still train (grads only
+    on adapters, base constant), loss decreases."""
+    from fedml_tpu.llm.quant import (
+        dequantize_tree, lora_apply_fn_quant, quant_bytes,
+        quantize_tree_int8,
+    )
+    from fedml_tpu.llm.lora import lora_init
+
+    # dims big enough that kernels cross the quantization size threshold
+    # (leaves < _MIN_QUANT_SIZE stay bf16 by design)
+    qV, qD, qFF = 512, 64, 256
+    model = TransformerLM(vocab_size=qV, d_model=qD, n_layers=L,
+                          n_heads=H, d_ff=qFF)
+    base = model.init(jax.random.key(0),
+                      jnp.zeros((1, T), jnp.int32))["params"]
+    qbase = quantize_tree_int8(base)
+
+    # dequant error per leaf <= scale/2 (half a quantization step)
+    deq = dequantize_tree(qbase, jnp.float32)
+    for (p1, a), (_p2, b) in zip(
+            jax.tree_util.tree_flatten_with_path(base)[0],
+            jax.tree_util.tree_flatten_with_path(deq)[0]):
+        a = np.asarray(a, np.float64)
+        b = np.asarray(b, np.float64)
+        if a.ndim >= 2 and a.size >= 4096:
+            step = np.abs(a).max(axis=tuple(range(a.ndim - 1)),
+                                 keepdims=True) / 127.0
+            assert (np.abs(a - b) <= step * 0.51 + 1e-8).all(), p1
+        else:
+            # bf16 passthrough
+            np.testing.assert_allclose(a, b, rtol=8e-3, atol=1e-6)
+
+    # storage: quantized leaves cost ~1 byte/param vs 4 (f32 base here)
+    from fedml_tpu.llm.lora import count_params
+    assert quant_bytes(qbase) < 0.45 * 4 * count_params(base)
+
+    # training: adapters learn through the quantized base
+    adapters = lora_init(jax.random.key(1), base, rank=4)
+    apply_q = lora_apply_fn_quant(model.apply, qbase)
+    rs = np.random.RandomState(0)
+    seqs = (rs.randint(1, qV, (8, 1)) + np.arange(T + 1)) % qV
+    x = jnp.asarray(seqs[:, :-1], jnp.int32)
+    y = jnp.asarray(seqs[:, 1:], jnp.int32)
+
+    @jax.jit
+    def step_fn(ad):
+        def loss_fn(a):
+            logits = apply_q({"params": a}, x)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return -jnp.take_along_axis(logp, y[..., None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(ad)
+        return jax.tree.map(lambda p, g: p - 0.5 * g, ad, grads), loss
+
+    losses = []
+    for _ in range(12):
+        adapters, loss = step_fn(adapters)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+    # quantized-base logits close to full-precision-base logits at init
+    apply_full = lora_apply_fn(model.apply, base)
+    z0 = lora_init(jax.random.key(1), base, rank=4)
+    lq = np.asarray(apply_q({"params": z0}, x), np.float32)
+    lf = np.asarray(apply_full({"params": z0}, x), np.float32)
+    assert np.abs(lq - lf).mean() < 0.1 * max(1.0, np.abs(lf).mean())
+
+
+def test_scan_layers_matches_unrolled_and_trains_quant_lora():
+    """TransformerLM(scan_layers=True): one compiled block lax.scan'd over
+    stacked [L, ...] params must reproduce the unrolled model exactly, keep
+    LoRA's merged-starts-at-base identity (stacked [L, din, r] adapters),
+    and train through an int8 base. This is what makes 7B-shape compile:
+    HLO is O(1) in depth instead of O(L)."""
+    from fedml_tpu.llm.lora import lora_init
+    from fedml_tpu.llm.quant import lora_apply_fn_quant, quantize_tree_int8
+
+    V, D, Ls, H2, FF2, T2 = 64, 32, 3, 4, 96, 16
+    scan_m = TransformerLM(vocab_size=V, d_model=D, n_layers=Ls, n_heads=H2,
+                           d_ff=FF2, scan_layers=True, remat=True)
+    p_scan = scan_m.init(jax.random.key(0),
+                         jnp.zeros((1, T2), jnp.int32))["params"]
+    assert set(p_scan) == {"blocks", "embed", "final_norm", "lm_head"}
+    # block kernels are stacked on a leading layer axis
+    assert p_scan["blocks"]["wq"]["kernel"].shape == (Ls, D, D)
+
+    unroll_m = TransformerLM(vocab_size=V, d_model=D, n_layers=Ls,
+                             n_heads=H2, d_ff=FF2)
+    p_unroll = {"embed": p_scan["embed"], "final_norm": p_scan["final_norm"],
+                "lm_head": p_scan["lm_head"]}
+    for i in range(Ls):
+        p_unroll[f"block_{i}"] = jax.tree.map(lambda a: a[i],
+                                              p_scan["blocks"])
+    x = jnp.asarray(np.random.RandomState(0).randint(0, V, (2, T2)),
+                    jnp.int32)
+    lo_s = scan_m.apply({"params": p_scan}, x)
+    lo_u = unroll_m.apply({"params": p_unroll}, x)
+    assert float(jnp.abs(lo_s - lo_u).max()) < 1e-4
+
+    ad = lora_init(jax.random.key(1), p_scan, rank=4)
+    assert ad["blocks/wq/kernel"]["a"].shape == (Ls, D, 4)
+    f = lora_apply_fn(scan_m.apply, p_scan)
+    assert float(jnp.abs(f({"params": ad}, x) - lo_s).max()) < 1e-5
+
+    qb = quantize_tree_int8(p_scan)
+    fq = lora_apply_fn_quant(scan_m.apply, qb)
+
+    @jax.jit
+    def step_fn(a):
+        def loss(a_):
+            lp = jax.nn.log_softmax(
+                fq({"params": a_}, x).astype(jnp.float32), -1)
+            y = jnp.roll(x, -1, 1)
+            return -jnp.take_along_axis(lp, y[..., None], -1).mean()
+
+        l, g = jax.value_and_grad(loss)(a)
+        return jax.tree.map(lambda p, gg: p - 0.5 * gg, a, g), l
+
+    losses = []
+    for _ in range(10):
+        ad, l = step_fn(ad)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses

@@ -225,3 +225,41 @@ def test_broker_artifact_republish_same_content_no_blob_leak():
         assert st.list() == []
     finally:
         release_broker(bid)
+
+
+def test_simulator_run_publishes_round_artifacts(tmp_path):
+    """Simulator.run publishes the aggregated model every round when an
+    artifact store is configured (reference: log_aggregated_model_info is
+    called from the aggregator each round)."""
+    import fedml_tpu as ft
+    from fedml_tpu.simulation.simulator import Simulator
+    from fedml_tpu.utils.artifacts import FileArtifactStore, aggregated_name
+
+    store = FileArtifactStore(str(tmp_path / "arts"))
+    mlops.set_artifact_store(store)
+    try:
+        cfg = ft.init(config={
+            "data_args": {"dataset": "synthetic",
+                          "extra": {"synthetic_samples_per_client": 16}},
+            "model_args": {"model": "lr"},
+            "train_args": {"federated_optimizer": "FedAvg",
+                           "client_num_in_total": 2,
+                           "client_num_per_round": 2, "comm_round": 3,
+                           "epochs": 1, "batch_size": 8,
+                           "learning_rate": 0.3},
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "sp"},
+        })
+        sim = Simulator(cfg)
+        sim.run(3)
+        assert {aggregated_name(r) for r in range(3)} <= set(store.list())
+        # fetched round-2 equals the final server params
+        import numpy as np
+        import jax
+        fetched = mlops.fetch_aggregated_model(2)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-7),
+            fetched, jax.device_get(sim.server_state.params))
+    finally:
+        mlops.set_artifact_store(None)

@@ -29,6 +29,7 @@ def test_cv_models_forward(name):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_vgg16_stages():
     model = hub.create("vgg16", 10)
     params = hub.init_params(model, (32, 32, 3), jax.random.key(0))
