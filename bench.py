@@ -694,9 +694,11 @@ def bench_fedllm_7b() -> dict:
                 "what makes 7B-scale fit"),
         }
 
+    # one full-7B attempt only: T2048 and T1024 fail identically in this
+    # environment's compile helper, and each failing compile costs ~2 min
+    # of the driver's bench budget
     ladder = [
         ("7b_int8_T2048", 4096, 32, 32, 11008, 1, 2048),
-        ("7b_int8_T1024", 4096, 32, 32, 11008, 1, 1024),
         ("3b_int8_T2048", 3200, 26, 32, 8640, 1, 2048),
     ]
     def clean(msg: str) -> str:
