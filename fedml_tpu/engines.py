@@ -4,9 +4,9 @@
 all train under one federation API — core/alg_frame/client_trainer.py is
 engine-agnostic and ml/engine/ml_engine_adapter.py bridges tensors. Round-2
 verdict accepted this repo's JAX-only stance but flagged the missing
-capability; this module closes it for the engine that matters in practice:
-a silo can train a **torch** nn.Module while the server, comm layer, and
-every other silo stay unchanged.)
+capability; this module closes it for the engines that exist in practice:
+a silo can train a **torch** nn.Module or a **tf.keras** model while the
+server, comm layer, and every other silo stay unchanged.)
 
 The bridge is the trainer contract (cross_silo/trainer.py SiloTrainer):
 
@@ -97,4 +97,92 @@ class TorchSiloTrainer:
         with torch.no_grad():
             xt = torch.tensor(np.asarray(x, np.float32), device=self.device)
             pred = self.model(xt).argmax(dim=1).cpu().numpy()
+        return {"test_acc": float((pred == np.asarray(y)).mean())}
+
+
+class TFSiloTrainer:
+    """Silo trainer over a TensorFlow/Keras model — the third engine of the
+    reference's adapter family (reference: ml/engine/ml_engine_adapter.py
+    :198 dispatches torch/tf/mxnet/jax; tf's model_params_to_device). Same
+    contract as TorchSiloTrainer: the wire format is a {name: ndarray}
+    pytree keyed by variable path in variable order, so TF silos federate
+    through FedServerManager / SecAgg / the scheduler with zero server
+    changes.
+
+    The loop is a plain tf.GradientTape SGD over numpy shards — no Keras
+    fit() machinery, mirroring the reference trainer's explicit minibatch
+    loop. mxnet stays by-design (not installed in any supported image);
+    its adapter would be this class with autograd.record() inside."""
+
+    def __init__(self, model, x: np.ndarray, y: np.ndarray,
+                 lr: float = 0.1, batch_size: int = 32, epochs: int = 1,
+                 seed: int = 0):
+        self.model = model
+        self.x = np.asarray(x, np.float32)
+        self.y = np.asarray(y, np.int64)
+        self.lr, self.bs, self.epochs = lr, batch_size, epochs
+        self.seed = seed
+        self.n_samples = int(self.x.shape[0])
+        # build variables eagerly so get/set_params see the full set
+        self.model(self.x[:1])
+
+    def _vars(self):
+        return self.model.trainable_variables
+
+    # Keys are zero-padded-index + variable name. Aggregators rebuild dicts
+    # in SORTED key order (jax.tree.map flattens dicts lexicographically),
+    # so set_params must look values up BY KEY, never by position — a
+    # positional zip silently mis-assigns weights once the model has >=10
+    # variables ("v10" sorts before "v2"); zero-padding additionally keeps
+    # the sorted order humane.
+    def _key(self, i: int, v) -> str:
+        return f"v{i:03d}/{v.name}"
+
+    def get_params(self) -> dict:
+        return {self._key(i, v): v.numpy().copy()
+                for i, v in enumerate(self._vars())}
+
+    def set_params(self, params: dict) -> None:
+        vs = self._vars()
+        if len(params) != len(vs):
+            raise ValueError(
+                f"param pytree has {len(params)} leaves, model has "
+                f"{len(vs)} trainable variables")
+        for i, v in enumerate(vs):
+            val = np.asarray(params[self._key(i, v)])
+            if val.shape != tuple(v.shape):
+                raise ValueError(
+                    f"shape mismatch for {self._key(i, v)}: got {val.shape}, "
+                    f"variable is {tuple(v.shape)}")
+            v.assign(val)
+
+    def train(self, params: Optional[dict], round_idx: int):
+        import tensorflow as tf
+
+        if params is not None:
+            self.set_params(params)
+        rng = np.random.RandomState(self.seed * 100003 + round_idx)
+        n, bs = self.n_samples, min(self.bs, self.n_samples)
+        losses = []
+        loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            for b in range(0, n - bs + 1, bs):
+                idx = order[b:b + bs]
+                xb = tf.constant(self.x[idx])
+                yb = tf.constant(self.y[idx])
+                with tf.GradientTape() as tape:
+                    loss = loss_fn(yb, self.model(xb, training=True))
+                grads = tape.gradient(loss, self._vars())
+                for v, g in zip(self._vars(), grads):
+                    if g is not None:
+                        v.assign_sub(self.lr * g)
+                losses.append(float(loss))
+        metrics = {"train_loss": float(np.mean(losses)) if losses else 0.0}
+        return self.get_params(), self.n_samples, metrics
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray) -> dict:
+        logits = self.model(np.asarray(x, np.float32), training=False)
+        pred = np.asarray(logits).argmax(axis=1)
         return {"test_acc": float((pred == np.asarray(y)).mean())}
