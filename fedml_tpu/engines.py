@@ -129,31 +129,37 @@ class TFSiloTrainer:
     def _vars(self):
         return self.model.trainable_variables
 
-    # Keys are zero-padded-index + variable name. Aggregators rebuild dicts
-    # in SORTED key order (jax.tree.map flattens dicts lexicographically),
-    # so set_params must look values up BY KEY, never by position — a
-    # positional zip silently mis-assigns weights once the model has >=10
-    # variables ("v10" sorts before "v2"); zero-padding additionally keeps
-    # the sorted order humane.
-    def _key(self, i: int, v) -> str:
-        return f"v{i:03d}/{v.name}"
+    # The wire format covers ALL variables (trainable + moving statistics
+    # like BatchNorm means, matching TorchSiloTrainer's full state_dict),
+    # keyed by zero-padded variable index ONLY. Two rules behind that:
+    # - aggregators rebuild dicts in SORTED key order (jax.tree.map
+    #   flattens lexicographically), so set_params must look values up BY
+    #   KEY — a positional zip mis-assigns weights at >=10 variables
+    #   ("v10" sorts before "v2"; zero-padding keeps sorted == creation
+    #   order) — and
+    # - the key must NOT embed v.name: legacy Keras uniquifies names
+    #   process-globally ("dense_2/kernel"), so two silos that built a
+    #   different number of models would disagree on keys. The index is
+    #   unique and stable for a fixed architecture.
+    def _key(self, i: int) -> str:
+        return f"v{i:03d}"
 
     def get_params(self) -> dict:
-        return {self._key(i, v): v.numpy().copy()
-                for i, v in enumerate(self._vars())}
+        return {self._key(i): v.numpy().copy()
+                for i, v in enumerate(self.model.variables)}
 
     def set_params(self, params: dict) -> None:
-        vs = self._vars()
+        vs = self.model.variables
         if len(params) != len(vs):
             raise ValueError(
                 f"param pytree has {len(params)} leaves, model has "
-                f"{len(vs)} trainable variables")
+                f"{len(vs)} variables")
         for i, v in enumerate(vs):
-            val = np.asarray(params[self._key(i, v)])
+            val = np.asarray(params[self._key(i)])
             if val.shape != tuple(v.shape):
                 raise ValueError(
-                    f"shape mismatch for {self._key(i, v)}: got {val.shape}, "
-                    f"variable is {tuple(v.shape)}")
+                    f"shape mismatch for {self._key(i)} ({v.name}): got "
+                    f"{val.shape}, variable is {tuple(v.shape)}")
             v.assign(val)
 
     def train(self, params: Optional[dict], round_idx: int):

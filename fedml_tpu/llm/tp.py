@@ -35,20 +35,38 @@ _ROW = ("wo", "w_down")                        # shard input features
 
 
 def tp_param_specs(params: Pytree, axis: str = "tp") -> Pytree:
-    """PartitionSpec tree for TransformerLM params (same structure)."""
+    """PartitionSpec tree for TransformerLM params (same structure).
+
+    Understands all three base layouts:
+    - unrolled 2-D kernels [din, dout] (the table above);
+    - scan-over-layers 3-D stacked kernels [L, din, dout]
+      (TransformerLM(scan_layers=True)) — same Megatron split on the
+      trailing two dims, layer axis replicated;
+    - int8-quantized bases (llm/quant.py {"q", "s"} leaves): "q" shards
+      like the kernel it stores; per-out-channel scales "s" shard their
+      last dim alongside column-split kernels and replicate for row-split
+      (a row split divides din; scales are per-dout). 7B int8 over tp=8
+      puts ~0.9GB of base on each chip.
+    """
 
     def spec_for(path, leaf):
         names = [str(getattr(p, "key", "")) for p in path]
-        if leaf.ndim != 2:
+        col = any(n in _COL for n in names)
+        row = any(n in _ROW for n in names)
+        if names and names[-1] == "s":        # quant scales [..., 1, dout]
+            return P(*([None] * (leaf.ndim - 1)), axis) if col else P()
+        if leaf.ndim == 2:
+            if col or "embed" in names or "lm_head" in names:
+                # embed [V, D] shards D; lm_head [D, V] shards V
+                return P(None, axis)
+            if row:
+                return P(axis, None)
             return P()
-        if any(n in _COL for n in names):
-            return P(None, axis)
-        if any(n in _ROW for n in names):
-            return P(axis, None)
-        if "embed" in names:                  # [V, D] -> shard D
-            return P(None, axis)
-        if "lm_head" in names:                # [D, V] -> shard V
-            return P(None, axis)
+        if leaf.ndim == 3:                    # stacked [L, din, dout]
+            if col:
+                return P(None, None, axis)
+            if row:
+                return P(None, axis, None)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

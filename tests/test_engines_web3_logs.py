@@ -316,8 +316,9 @@ def test_tf_set_params_survives_sorted_dict_rebuild_10plus_vars():
     from fedml_tpu.engines import TFSiloTrainer
 
     layers = [tf.keras.layers.Dense(6, activation="relu")
-              for _ in range(6)] + [tf.keras.layers.Dense(3)]
-    model = tf.keras.Sequential(layers)   # 14 trainable variables
+              for _ in range(5)] + [tf.keras.layers.BatchNormalization(),
+                                    tf.keras.layers.Dense(3)]
+    model = tf.keras.Sequential(layers)   # >=16 vars incl. BN moving stats
     x, y = _mk_data(0)
     tr = TFSiloTrainer(model, x, y)
     p = tr.get_params()
@@ -328,7 +329,21 @@ def test_tf_set_params_survives_sorted_dict_rebuild_10plus_vars():
         np.testing.assert_array_equal(v, p[k])
     # loud failure on a transposed kernel
     bad = dict(p)
-    k0 = next(k for k in bad if bad[k].ndim == 2)
+    k0 = next(k for k in bad if bad[k].ndim == 2 and
+              bad[k].shape[0] != bad[k].shape[1])
     bad[k0] = bad[k0].T.copy()
     with pytest.raises(ValueError, match="shape mismatch"):
         tr.set_params(bad)
+    # BN moving statistics ride the wire format (torch state_dict parity):
+    # train moves them, and set_params restores the moved values exactly
+    tr.set_params(p)
+    p_trained, _, _ = tr.train(None, 0)
+    bn_moved = any(
+        not np.array_equal(a, b) and "v" in k
+        for (k, a), b in zip(p_trained.items(), p.values())
+        if a.ndim == 1)
+    assert bn_moved
+    tr2 = TFSiloTrainer(tf.keras.models.clone_model(model), x, y)
+    tr2.set_params(p_trained)
+    for a, b in zip(tr2.get_params().values(), p_trained.values()):
+        np.testing.assert_array_equal(a, b)

@@ -101,3 +101,56 @@ def test_tp_base_with_replicated_lora_adapters():
     out = fwd(tp_base, adapters, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_tp_shards_scan_layers_and_int8_base():
+    """TP specs understand the stacked scan-layers layout AND the int8
+    quantized base: the 7B-on-a-pod composition — scanned [L,...] params
+    Megatron-split on their trailing dims, quantized {"q","s"} leaves
+    sharded like the kernels they store — produces the same logits as the
+    unsharded quantized model."""
+    import numpy as np
+
+    from fedml_tpu.llm.quant import dequantize_tree, quantize_tree_int8
+    from fedml_tpu.llm.tp import shard_params_tp, tp_param_specs
+    from fedml_tpu.llm.transformer import TransformerLM
+    from fedml_tpu.parallel.mesh import make_mesh
+
+    # dims large enough that block kernels cross the int8 size threshold
+    V, D, L, H, FF, T = 64, 64, 3, 4, 256, 16
+    model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                          d_ff=FF, scan_layers=True)
+    base = model.init(jax.random.key(0),
+                      jnp.zeros((1, T), jnp.int32))["params"]
+    qbase = quantize_tree_int8(base)
+
+    specs = tp_param_specs(qbase)
+    # stacked col kernel shards its dout (axis 2), row its din (axis 1)
+    assert str(specs["blocks"]["wq"]["kernel"]["q"]) == \
+        str(jax.sharding.PartitionSpec(None, None, "tp"))
+    assert str(specs["blocks"]["w_down"]["kernel"]["q"]) == \
+        str(jax.sharding.PartitionSpec(None, "tp", None))
+    # col scales shard dout; row scales replicate
+    assert "tp" in str(specs["blocks"]["wq"]["kernel"]["s"])
+    assert "tp" not in str(specs["blocks"]["w_down"]["kernel"]["s"])
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    qtp = shard_params_tp(qbase, mesh)
+
+    # forward over the dequantized TP base == unsharded dequantized model
+    x = jnp.asarray(np.random.RandomState(0).randint(0, V, (4, T)),
+                    jnp.int32)
+
+    @jax.jit
+    def fwd_q(qp, tokens):
+        return model.apply({"params": dequantize_tree(qp, jnp.float32)},
+                           tokens)
+
+    ref = model.apply(
+        {"params": dequantize_tree(qbase, jnp.float32)}, x)
+    got = fwd_q(qtp, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-3)
+    # sharded leaves really are distributed over tp
+    q_leaf = qtp["blocks"]["wq"]["kernel"]["q"]
+    assert "tp" in str(q_leaf.sharding.spec)
