@@ -686,12 +686,16 @@ def bench_fedllm_7b() -> dict:
             f"{prefix}_mfu_vs_spec_peak": round(achieved / spec, 3)
             if (achieved and spec) else None,
             f"{prefix}_hbm_note": (
-                f"int8 base {base_gb:.2f}GB + adapters "
+                f"int8 base {base_gb:.2f}GB + dense merged scan stack "
+                f"~{2 * n_params / 2**30:.2f}GB(bf16, materialized as scan "
+                f"operands under scan_layers) + adapters "
                 f"{count_params(ad) * 4 / 2**30:.3f}GB + remat block "
                 f"checkpoints ~{ckpt_gb:.2f}GB + logits "
-                f"{B * T * vocab * 4 / 2**30:.2f}GB(f32) on a 16GB v5e; "
-                "bf16 7B base (14GB) does not leave room — int8 storage is "
-                "what makes 7B-scale fit"),
+                f"{B * T * vocab * 4 / 2**30:.2f}GB(f32) on a 16GB v5e. "
+                "Unrolled layout would keep one-block liveness (int8 + one "
+                "bf16 block) but exceeds this environment's compile "
+                "service at 7B depth; in-scan per-layer dequant is the "
+                "noted future fix for full-7B single-chip"),
         }
 
     # one full-7B attempt only: T2048 and T1024 fail identically in this

@@ -15,6 +15,17 @@ are HBM-negligible and precision-critical. This is a storage format, not a
 compute format: matmuls still run bf16 on the MXU (int8 matmul would change
 numerics; the MXU win here is memory, which is the actual 7B bottleneck).
 
+MEMORY CAVEAT — layout matters: the per-block-liveness argument above holds
+for the UNROLLED layer layout, where each dequantized weight's live range
+is one block. Under scan-over-layers (TransformerLM(scan_layers=True)) the
+dequantized+merged stack becomes lax.scan operands, which XLA materializes
+in full — peak HBM is then int8 base PLUS the dense merged stack (measured:
+the 3.4B scan+int8 bench rung runs at ~9.6 GB; full 7B under scan would
+need ~21 GB and does not fit one v5e). Recovering one-block liveness under
+scan means dequantizing/merging per layer slice INSIDE the scanned block —
+a functional block rewrite, noted as future work. On TP meshes the merged
+stack is tp-sharded, so the per-chip cost is merged/|tp| + int8/|tp|.
+
 No reference equivalent — the reference's FedLLM (spotlight_prj/fedllm)
 inherits HF peft/bitsandbytes for this; on TPU the transform is ~60 lines
 of pytree surgery.
@@ -60,7 +71,11 @@ def _is_q(leaf) -> bool:
 def dequant_leaf(leaf, dtype=jnp.bfloat16):
     if _is_q(leaf):
         return (leaf["q"].astype(jnp.float32) * leaf["s"]).astype(dtype)
-    return leaf
+    # bf16 passthrough leaves also cast, so the dequantized tree has ONE
+    # uniform dtype — a mixed bf16/f32 tree flips the layer-scan carry
+    # dtype mid-loop and lax.scan rejects it
+    return leaf.astype(dtype) if jnp.issubdtype(
+        jnp.asarray(leaf).dtype, jnp.floating) else leaf
 
 
 def dequantize_tree(qparams: Pytree, dtype=jnp.bfloat16) -> Pytree:

@@ -65,37 +65,71 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
                         seq_axis: Optional[str] = "seq",
                         dp_axis: str = "dp",
                         compute_dtype: str = "bfloat16",
+                        scan_layers: bool = False,
+                        quantize_base: bool = False,
                         rng: Optional[jax.Array] = None):
     """Construct the full scaled stack: returns (model, base_sharded,
     adapters, step_fn) where step_fn(adapters, tokens, targets) ->
     (adapters, loss) trains ONLY the adapters against the TP-sharded frozen
-    base with ring attention + remat under one jit."""
+    base with ring attention + remat under one jit.
+
+    Two extra knobs complete the 7B-pod composition:
+    - scan_layers: lax.scan one compiled block over stacked [L, ...] params
+      (O(1)-in-depth HLO; deep models whose unrolled program exceeds a
+      compile service's limits). LoRA adapters and TP specs follow the
+      stacked layout automatically.
+    - quantize_base: store the frozen base int8 (llm/quant.py) — ~1 byte/
+      param spread over the tp axis, dequantized to compute_dtype inside
+      the step (per-chip: int8/|tp| plus the tp-sharded dense merged
+      weights; see quant.py's MEMORY CAVEAT for the scan-layout
+      materialization details).
+    """
     rng = jax.random.key(0) if rng is None else rng
     # a mesh without the seq axis degrades to dense attention AND an
     # unsharded sequence dim — both guards must agree on mesh membership
     has_seq = bool(seq_axis) and seq_axis in mesh.axis_names
+    if scan_layers and has_seq:
+        raise ValueError(
+            "scan_layers does not compose with the ring-attention seq axis: "
+            "flax nn.scan's broadcast-constant tracing rejects a shard_map "
+            "island inside the scanned block ('broadcasted variable has a "
+            "data dependency on the scan body'). Pick one: seq_axis=None "
+            "(scan + TP + dp — the deep-model layout; attention stays "
+            "per-chip) or scan_layers=False (unrolled blocks + ring "
+            "attention — the long-context layout).")
     attn = (make_ring_attn_fn(mesh, seq_axis=seq_axis, dp_axis=dp_axis)
             if has_seq else None)
     model = model_cls(vocab_size=vocab_size, d_model=d_model,
                       n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
-                      attn_fn=attn, remat=True)
+                      attn_fn=attn, remat=True, scan_layers=scan_layers)
     # init DIRECTLY into the TP layout: jit the initializer with its output
     # shardings set to the Megatron specs, so each device materializes only
     # its own shard — the full base never exists replicated anywhere
     host_model = model_cls(vocab_size=vocab_size, d_model=d_model,
                            n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
-                           remat=True)
+                           remat=True, scan_layers=scan_layers)
     dtype = jnp.dtype(compute_dtype)
 
-    def init_fn(r):
-        p = host_model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
-        return jax.tree.map(lambda a: a.astype(dtype), p)
+    def raw_init(r):
+        return host_model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+
+    if quantize_base:
+        from .quant import dequantize_tree, quantize_tree_int8
+
+        def init_fn(r):
+            return quantize_tree_int8(raw_init(r))
+    else:
+        def init_fn(r):
+            return jax.tree.map(lambda a: a.astype(dtype), raw_init(r))
 
     shape_tree = jax.eval_shape(init_fn, rng)
     specs = tp_param_specs(shape_tree)
     out_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
     base = jax.jit(init_fn, out_shardings=out_shardings)(rng)
-    adapters = lora_init(jax.random.fold_in(rng, 1), base, rank=rank)
+    # adapters need the UNQUANTIZED kernel shapes (lora_init matches on
+    # `.../kernel` paths, which a quantized tree nests under {q, s})
+    adapters = lora_init(jax.random.fold_in(rng, 1),
+                         jax.eval_shape(raw_init, rng), rank=rank)
 
     batch_spec = NamedSharding(
         mesh, P(dp_axis, seq_axis if has_seq else None))
@@ -108,7 +142,9 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
         targets = jax.lax.with_sharding_constraint(targets, batch_spec)
 
         def loss_fn(ad):
-            merged = lora_merge(base, ad, alpha)
+            dense_base = (dequantize_tree(base, dtype) if quantize_base
+                          else base)
+            merged = lora_merge(dense_base, ad, alpha)
             logits = model.apply({"params": merged}, tokens)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
             ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]

@@ -196,3 +196,52 @@ def test_scan_layers_matches_unrolled_and_trains_quant_lora():
         ad, l = step_fn(ad)
         losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+def test_scaled_fedllm_scan_int8_full_composition():
+    """The complete 7B-pod program at tiny dims: TP-sharded INT8 frozen
+    base x stacked scan-layers x replicated LoRA x ring attention x remat,
+    one jit over the (dp, tp, seq) mesh — loss finite and close to the
+    dense full-precision reference, adapters train, base stays int8 and
+    TP-sharded. scan_layers + the ring seq axis is an explicit non-combo
+    (flax nn.scan rejects shard_map islands in the scanned body), so the
+    deep-model layout runs on a (dp, tp) mesh with per-chip attention."""
+    with pytest.raises(ValueError, match="scan_layers does not compose"):
+        build_scaled_fedllm(
+            TransformerLM, make_mesh({"dp": 2, "tp": 2, "seq": 2}),
+            vocab_size=VOCAB, d_model=D, n_layers=L, n_heads=H, d_ff=256,
+            scan_layers=True, quantize_base=True)
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    model, base, adapters, step = build_scaled_fedllm(
+        TransformerLM, mesh, vocab_size=VOCAB, d_model=D, n_layers=L,
+        n_heads=H, d_ff=256, rank=4, lr=0.5, compute_dtype="float32",
+        scan_layers=True, quantize_base=True, seq_axis=None)
+    # the stacked block kernels are stored quantized and tp-sharded
+    blk = base["blocks"]["w_gate"]["kernel"]
+    assert set(blk) == {"q", "s"} and blk["q"].dtype == jnp.int8
+    assert "tp" in str(blk["q"].sharding.spec)
+
+    rs = np.random.RandomState(0)
+    seqs = (rs.randint(0, VOCAB, (4, 1)) + np.arange(T + 1)) % VOCAB
+    x = jnp.asarray(seqs[:, :-1], jnp.int32)
+    y = jnp.asarray(seqs[:, 1:], jnp.int32)
+
+    # dense full-precision reference with the SAME dequantized base
+    from fedml_tpu.llm.quant import dequantize_tree
+
+    dense_model = TransformerLM(vocab_size=VOCAB, d_model=D, n_layers=L,
+                                n_heads=H, d_ff=256, scan_layers=True)
+    deq = jax.tree.map(np.asarray, dequantize_tree(base, jnp.float32))
+    ref_apply = lora_apply_fn(dense_model.apply, deq)
+    logits = ref_apply({"params": adapters}, x)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ref_loss = -jnp.take_along_axis(logp, y[..., None], -1).mean()
+
+    ad, loss1 = step(adapters, x, y)
+    assert abs(float(loss1) - float(ref_loss)) < 1e-2, (loss1, ref_loss)
+    losses = [float(loss1)]
+    for _ in range(8):
+        ad, l = step(ad, x, y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
