@@ -610,7 +610,7 @@ def bench_fedllm_7b() -> dict:
 
     from fedml_tpu.llm.lora import count_params, lora_init
     from fedml_tpu.llm.quant import (
-        lora_apply_fn_quant, quant_bytes, synth_quantized_base,
+        make_inscan_quant_apply, quant_bytes, synth_quantized_base,
     )
     from fedml_tpu.llm.transformer import TransformerLM
     from fedml_tpu.ops.flash_attention import flash_attn_fn
@@ -632,10 +632,15 @@ def bench_fedllm_7b() -> dict:
     vocab = 32000
 
     def rung(name, d_model, n_layers, n_heads, d_ff, B, T, prefix):
+        # in-scan per-layer dequant (llm/quant.py make_inscan_quant_apply):
+        # each scan step dequantizes + LoRA-merges ONE block, so peak HBM is
+        # int8 base + one dense block + remat checkpoints, and the HLO is
+        # O(1) in depth — BOTH constraints that blocked full-7B (the
+        # unrolled program crashed the remote compile service; the
+        # module-level scan materialized the dense merged stack)
         model = TransformerLM(
             vocab_size=vocab, d_model=d_model, n_layers=n_layers,
-            n_heads=n_heads, d_ff=d_ff, attn_fn=flash_attn_fn,
-            remat=True, scan_layers=True)
+            n_heads=n_heads, d_ff=d_ff, scan_layers=True)
         shapes = jax.eval_shape(
             lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))
             ["params"], jax.random.key(0))
@@ -644,13 +649,13 @@ def bench_fedllm_7b() -> dict:
             jax.random.key(0), shapes))()
         base_gb = quant_bytes(qbase) / 2**30
         adapters = lora_init(jax.random.key(1), shapes, rank=8)
+        apply_fn = make_inscan_quant_apply(
+            n_heads, attn_fn=flash_attn_fn, remat=True)
 
         @jax.jit
         def step(qb, ad, x, y):
-            apply_fn = lora_apply_fn_quant(model.apply, qb)
-
             def loss_fn(a):
-                logits = apply_fn({"params": a}, x)
+                logits = apply_fn(qb, a, x)
                 logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
                 return -jnp.take_along_axis(logp, y[..., None], -1).mean()
 
@@ -679,23 +684,23 @@ def bench_fedllm_7b() -> dict:
         return {
             f"{prefix}_config": f"{name} d{d_model} L{n_layers} ff{d_ff} "
                                 f"vocab{vocab} B{B} T{T} int8-base lora-r8 "
-                                "remat flash scan-layers",
+                                "remat flash scan-layers inscan-dequant",
             f"{prefix}_params": n_params,
             f"{prefix}_tokens_per_sec": round(B * T / dt, 0),
             f"{prefix}_step_time_ms": round(dt * 1e3, 1),
             f"{prefix}_mfu_vs_spec_peak": round(achieved / spec, 3)
             if (achieved and spec) else None,
             f"{prefix}_hbm_note": (
-                f"int8 base {base_gb:.2f}GB + dense merged scan stack "
-                f"~{2 * n_params / 2**30:.2f}GB(bf16, materialized as scan "
-                f"operands under scan_layers) + adapters "
+                f"int8 base {base_gb:.2f}GB + ONE dense block "
+                f"~{2 * n_params / n_layers / 2**30:.2f}GB(bf16, in-scan "
+                "per-layer dequant keeps single-block liveness) + adapters "
                 f"{count_params(ad) * 4 / 2**30:.3f}GB + remat block "
                 f"checkpoints ~{ckpt_gb:.2f}GB + logits "
-                f"{B * T * vocab * 4 / 2**30:.2f}GB(f32) on a 16GB v5e. "
-                "Unrolled layout would keep one-block liveness (int8 + one "
-                "bf16 block) but exceeds this environment's compile "
-                "service at 7B depth; in-scan per-layer dequant is the "
-                "noted future fix for full-7B single-chip"),
+                f"{B * T * vocab * 4 / 2**30:.2f}GB(f32) on a 16GB v5e; "
+                "a bf16 7B base alone (14GB) would not leave room — int8 "
+                "storage + in-scan dequant is what makes full-7B fit AND "
+                "compile (int8 weight reads also halve HBM traffic, which "
+                "is why MFU beats the bf16 1.2B row)"),
         }
 
     # one full-7B attempt only: T2048 and T1024 fail identically in this

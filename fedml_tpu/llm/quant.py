@@ -16,15 +16,16 @@ compute format: matmuls still run bf16 on the MXU (int8 matmul would change
 numerics; the MXU win here is memory, which is the actual 7B bottleneck).
 
 MEMORY CAVEAT — layout matters: the per-block-liveness argument above holds
-for the UNROLLED layer layout, where each dequantized weight's live range
-is one block. Under scan-over-layers (TransformerLM(scan_layers=True)) the
-dequantized+merged stack becomes lax.scan operands, which XLA materializes
-in full — peak HBM is then int8 base PLUS the dense merged stack (measured:
-the 3.4B scan+int8 bench rung runs at ~9.6 GB; full 7B under scan would
-need ~21 GB and does not fit one v5e). Recovering one-block liveness under
-scan means dequantizing/merging per layer slice INSIDE the scanned block —
-a functional block rewrite, noted as future work. On TP meshes the merged
-stack is tp-sharded, so the per-chip cost is merged/|tp| + int8/|tp|.
+for the UNROLLED layer layout, and for the in-scan form below. The
+MODULE-level scan path (TransformerLM(scan_layers=True) applied to a
+dequantized tree, e.g. lora_apply_fn_quant / scale.build_scaled_fedllm)
+materializes the dequantized+merged stack as lax.scan operands — peak HBM
+is then int8 base PLUS the dense merged stack (on TP meshes both are
+tp-sharded, so per-chip cost is (int8 + merged)/|tp|). The form that keeps
+single-block liveness UNDER scan is `make_inscan_quant_apply` below: it
+dequantizes + LoRA-merges one layer slice inside the scanned body, which is
+what lets the full 7B shape both compile (O(1)-in-depth HLO) and fit one
+16 GB v5e (measured: 6.74B at 0.699 MFU — see bench_fedllm_7b).
 
 No reference equivalent — the reference's FedLLM (spotlight_prj/fedllm)
 inherits HF peft/bitsandbytes for this; on TPU the transform is ~60 lines
@@ -43,14 +44,30 @@ Pytree = Any
 _MIN_QUANT_SIZE = 4096   # leaves smaller than this stay bf16
 
 
-def quantize_tree_int8(params: Pytree) -> Pytree:
-    """Replace every large float leaf with {"q": int8, "s": f32 scales}.
-    Structure is preserved; dequantize_tree inverts."""
+_QUANT_SUFFIXES = ("kernel", "embedding")
 
-    def one(leaf):
-        if leaf.ndim < 2 or leaf.size < _MIN_QUANT_SIZE or \
-                not jnp.issubdtype(leaf.dtype, jnp.floating):
-            return jnp.asarray(leaf, jnp.bfloat16)
+
+def _quantizable(path_names, leaf) -> bool:
+    """Quantize only actual matmul weights — leaves whose path ends with
+    `kernel` or `embedding`. A dimension heuristic cannot tell a stacked
+    norm-scale tree [L, D] from a kernel once L is large (a 70B shape has
+    80 layers), and norm scales must stay bf16: they are precision-critical,
+    HBM-negligible, and an int8 {q,s} with a layer-reduced scale would also
+    break the in-scan leading-axis contract."""
+    return (path_names and path_names[-1] in _QUANT_SUFFIXES
+            and leaf.ndim >= 2 and leaf.size >= _MIN_QUANT_SIZE
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def quantize_tree_int8(params: Pytree) -> Pytree:
+    """Replace kernel/embedding float leaves with {"q": int8, "s": f32
+    scales}. Structure is preserved; dequantize_tree inverts."""
+
+    def one(path, leaf):
+        names = [str(getattr(p, "key", "")) for p in path]
+        if not _quantizable(names, leaf):
+            return jnp.asarray(leaf, jnp.bfloat16) if jnp.issubdtype(
+                leaf.dtype, jnp.floating) else leaf
         w = leaf.astype(jnp.float32)
         # per-out-channel scales: reduce all axes but the last — except for
         # 3-D stacked scan-layer kernels [L, din, dout], which keep their
@@ -61,7 +78,7 @@ def quantize_tree_int8(params: Pytree) -> Pytree:
         q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
         return {"q": q, "s": s}
 
-    return jax.tree.map(one, params)
+    return jax.tree_util.tree_map_with_path(one, params)
 
 
 def _is_q(leaf) -> bool:
@@ -101,21 +118,118 @@ def synth_quantized_base(rng: jax.Array, shapes: Pytree) -> Pytree:
     int8 directly avoids ever materializing the f32/bf16 init (a 7B f32
     init is 28 GB — it could never be quantized after the fact on a 16 GB
     chip). Same quantize/passthrough rule as quantize_tree_int8."""
-    leaves, treedef = jax.tree_util.tree_flatten(shapes)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    leaves = [(path, sd) for path, sd in flat]
     keys = jax.random.split(rng, max(1, len(leaves)))
 
-    def build(i, sd):
-        if sd.ndim < 2 or sd.size < _MIN_QUANT_SIZE or \
-                not jnp.issubdtype(sd.dtype, jnp.floating):
+    def build(i, path, sd):
+        names = [str(getattr(p, "key", "")) for p in path]
+        if not _quantizable(names, sd):
             return 0.02 * jax.random.normal(keys[i], sd.shape, jnp.bfloat16)
         q = jax.random.randint(keys[i], sd.shape, -127, 128, jnp.int8)
         fan_in = sd.shape[-2] if sd.ndim > 1 else sd.shape[0]
-        s = jnp.full(tuple(1 for _ in sd.shape[:-1]) + sd.shape[-1:],
-                     (3.0 / max(fan_in, 1)) ** 0.5 / 127.0, jnp.float32)
+        # scale shapes must MATCH quantize_tree_int8's exactly (3-D stacked
+        # kernels keep their leading layer axis: [L, 1, dout]) — the
+        # in-scan apply scans the s leaves alongside q
+        s_shape = ((sd.shape[0], 1, sd.shape[-1]) if sd.ndim == 3
+                   else tuple(1 for _ in sd.shape[:-1]) + sd.shape[-1:])
+        s = jnp.full(s_shape, (3.0 / max(fan_in, 1)) ** 0.5 / 127.0,
+                     jnp.float32)
         return {"q": q, "s": s}
 
     return jax.tree_util.tree_unflatten(
-        treedef, [build(i, sd) for i, sd in enumerate(leaves)])
+        treedef, [build(i, path, sd)
+                  for i, (path, sd) in enumerate(leaves)])
+
+
+def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
+                            remat: bool = True, dtype=jnp.bfloat16,
+                            eps: float = 1e-6):
+    """Forward for a scan-layers TransformerLM whose base stays int8 INSIDE
+    the layer scan — the memory-preserving form of the scan+quant combo
+    (see MEMORY CAVEAT above): each scan step receives one layer's q/s
+    slices and its LoRA slice, dequantizes + merges just that block, uses
+    it, and lets XLA free it. Peak HBM ≈ int8 base + ONE dense block +
+    remat checkpoints, at O(1)-in-depth HLO — what lets a full 7B-shape
+    step both compile and fit on one 16 GB chip.
+
+    Functional mirror of transformer.Block (RMSNorm → RoPE causal MHA →
+    RMSNorm → SwiGLU; kernels bias-free) — the parity test pins the two
+    implementations together (tests/test_fedllm_scale.py).
+
+    Returns apply(qparams, adapters, tokens) -> logits, where qparams is
+    quantize_tree_int8 of a TransformerLM(scan_layers=True) init and
+    adapters is llm.lora.lora_init of the same (stacked [L, ...] a/b).
+    Gradients w.r.t. adapters flow through the scan (per-layer slices are
+    scanned inputs).
+    """
+    from ..parallel.seq import dense_causal_attention
+    from .transformer import rope
+
+    attn = attn_fn or dense_causal_attention
+
+    def norm(x, scale):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+    def dq(leaf):
+        return dequant_leaf(leaf, dtype)
+
+    def merged(bl, ad_l, name, rank_scale):
+        w = dq(bl[name]["kernel"])
+        a = ad_l.get(f"{name}/kernel")
+        if a is not None:
+            w = w + rank_scale * (a["a"] @ a["b"]).astype(w.dtype)
+        return w
+
+    def apply(qparams, adapters, tokens):
+        rank = next(iter(adapters.values()))["a"].shape[-1]
+        rank_scale = alpha / rank
+        # split adapters into stacked per-block slices vs top-level ones
+        blk_ads = {k[len("blocks/"):]: v for k, v in adapters.items()
+                   if k.startswith("blocks/")}
+        top_ads = {k: v for k, v in adapters.items()
+                   if not k.startswith("blocks/")}
+        emb = dq(qparams["embed"]["embedding"])
+        x = emb[tokens]
+        pos = jnp.arange(tokens.shape[1])
+
+        def body(x, layer):
+            bl, ad_l = layer
+            d_model = x.shape[-1]
+            dh = d_model // n_heads
+            h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
+            q = h @ merged(bl, ad_l, "wq", rank_scale)
+            k = h @ merged(bl, ad_l, "wk", rank_scale)
+            v = h @ merged(bl, ad_l, "wv", rank_scale)
+            split = lambda a: a.reshape(a.shape[:2] + (n_heads, dh))
+            q, k, v = split(q), split(k), split(v)
+            q, k = rope(q, pos), rope(k, pos)
+            o = attn(q, k, v).reshape(x.shape[:2] + (d_model,))
+            x = x + o @ merged(bl, ad_l, "wo", rank_scale)
+            h = norm(x, dq(bl["RMSNorm_1"]["scale"]))
+            gate = h @ merged(bl, ad_l, "w_gate", rank_scale)
+            up = h @ merged(bl, ad_l, "w_up", rank_scale)
+            x = x + (jax.nn.silu(gate) * up) @ merged(
+                bl, ad_l, "w_down", rank_scale)
+            return x, None
+
+        if remat:
+            # prevent_cse=False: CSE barriers are unnecessary under scan
+            # and inhibit fusion (same setting as transformer.py's
+            # nn.remat(Block, prevent_cse=False) — the flax remat_scan
+            # pattern this function mirrors)
+            body = jax.checkpoint(body, prevent_cse=False)
+        x, _ = jax.lax.scan(body, x, (qparams["blocks"], blk_ads))
+        x = norm(x, dq(qparams["final_norm"]["scale"]))
+        head = dq(qparams["lm_head"]["kernel"])
+        a = top_ads.get("lm_head/kernel")
+        if a is not None:
+            head = head + rank_scale * (a["a"] @ a["b"]).astype(head.dtype)
+        return x @ head
+
+    return apply
 
 
 def lora_apply_fn_quant(apply_fn, qbase: Pytree, alpha: float = 16.0):

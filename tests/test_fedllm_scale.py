@@ -213,8 +213,10 @@ def test_scaled_fedllm_scan_int8_full_composition():
             scan_layers=True, quantize_base=True)
 
     mesh = make_mesh({"dp": 2, "tp": 4})
+    # d_model >= 64 so the stacked kernels cross the (kernel-like) int8
+    # quantization rule
     model, base, adapters, step = build_scaled_fedllm(
-        TransformerLM, mesh, vocab_size=VOCAB, d_model=D, n_layers=L,
+        TransformerLM, mesh, vocab_size=VOCAB, d_model=64, n_layers=L,
         n_heads=H, d_ff=256, rank=4, lr=0.5, compute_dtype="float32",
         scan_layers=True, quantize_base=True, seq_axis=None)
     # the stacked block kernels are stored quantized and tp-sharded
@@ -230,7 +232,7 @@ def test_scaled_fedllm_scan_int8_full_composition():
     # dense full-precision reference with the SAME dequantized base
     from fedml_tpu.llm.quant import dequantize_tree
 
-    dense_model = TransformerLM(vocab_size=VOCAB, d_model=D, n_layers=L,
+    dense_model = TransformerLM(vocab_size=VOCAB, d_model=64, n_layers=L,
                                 n_heads=H, d_ff=256, scan_layers=True)
     deq = jax.tree.map(np.asarray, dequantize_tree(base, jnp.float32))
     ref_apply = lora_apply_fn(dense_model.apply, deq)
@@ -245,3 +247,58 @@ def test_scaled_fedllm_scan_int8_full_composition():
         ad, l = step(ad, x, y)
         losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+def test_inscan_quant_apply_matches_module_and_trains():
+    """make_inscan_quant_apply (per-layer dequant INSIDE the layer scan —
+    the memory-preserving 7B form) must match TransformerLM(scan_layers=
+    True) applied to the dequantized+merged params, and train adapters
+    through the scan."""
+    from fedml_tpu.llm.lora import lora_init
+    from fedml_tpu.llm.quant import (
+        dequantize_tree, make_inscan_quant_apply, quantize_tree_int8,
+    )
+
+    V2, D2, L2, H3, FF3, T3 = 128, 64, 3, 4, 256, 16
+    model = TransformerLM(vocab_size=V2, d_model=D2, n_layers=L2,
+                          n_heads=H3, d_ff=FF3, scan_layers=True)
+    base = model.init(jax.random.key(0),
+                      jnp.zeros((1, T3), jnp.int32))["params"]
+    qbase = quantize_tree_int8(base)
+    adapters = lora_init(jax.random.key(1), base, rank=4, a_std=0.3)
+    # make the adapters matter: nonzero B so the merge isn't the identity
+    adapters = jax.tree.map(
+        lambda a: a + 0.1 * jnp.ones_like(a), adapters)
+
+    apply_inscan = make_inscan_quant_apply(H3, dtype=jnp.float32,
+                                           remat=True)
+    x = jnp.asarray(np.random.RandomState(0).randint(0, V2, (2, T3)),
+                    jnp.int32)
+    got = apply_inscan(qbase, adapters, x)
+
+    # reference: module applied to the dequantized base merged with the
+    # SAME adapters
+    deq = dequantize_tree(qbase, jnp.float32)
+    ref = lora_apply_fn(model.apply, deq)({"params": adapters}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=5e-4, rtol=5e-3)
+
+    # trains: grads flow to adapters through the scanned slices
+    y = jnp.roll(x, -1, 1)
+
+    @jax.jit
+    def step(ad):
+        def loss(a_):
+            lp = jax.nn.log_softmax(
+                apply_inscan(qbase, a_, x).astype(jnp.float32), -1)
+            return -jnp.take_along_axis(lp, y[..., None], -1).mean()
+
+        l, g = jax.value_and_grad(loss)(ad)
+        return jax.tree.map(lambda p, gg: p - 0.5 * gg, ad, g), l
+
+    ad = lora_init(jax.random.key(1), base, rank=4)
+    losses = []
+    for _ in range(10):
+        ad, l = step(ad)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] - 0.1, losses
