@@ -19,6 +19,7 @@ _CASES = [
     ("hierarchical_cross_silo.py", []),
     ("fedllm_lora.py", []),
     ("fedllm_lora.py", ["--ring"]),
+    ("fedllm_lora.py", ["--int8"]),
     ("serving_deploy.py", []),
     ("attack_vs_defense.py", []),
     ("federated_analytics.py", []),
