@@ -302,3 +302,31 @@ def test_inscan_quant_apply_matches_module_and_trains():
         ad, l = step(ad)
         losses.append(float(l))
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_quantized_base_sharded_checkpoint_roundtrip(tmp_path):
+    """The int8 TP-sharded base round-trips through the sharded orbax
+    checkpoint path (save_base_sharded / restore_base_sharded) — the 7B
+    deployment's persistence story: each host stores its int8 shards, and
+    restore lands them back TP-sharded without a dense detour."""
+    from fedml_tpu.llm.quant import quantize_tree_int8
+    from fedml_tpu.llm.tp import shard_params_tp
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    model = TransformerLM(vocab_size=VOCAB, d_model=64, n_layers=L,
+                          n_heads=H, d_ff=256, scan_layers=True)
+    base = model.init(jax.random.key(0),
+                      jnp.zeros((1, T), jnp.int32))["params"]
+    qtp = shard_params_tp(quantize_tree_int8(base), mesh)
+    save_base_sharded(str(tmp_path / "qbase"), qtp)
+    got = restore_base_sharded(
+        str(tmp_path / "qbase"),
+        jax.tree.map(np.asarray, qtp), mesh)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        qtp, got)
+    # int8 dtype and TP sharding survive the round trip
+    blk = got["blocks"]["wq"]["kernel"]
+    assert blk["q"].dtype == jnp.int8
+    assert "tp" in str(blk["q"].sharding.spec)
