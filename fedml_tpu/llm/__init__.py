@@ -84,6 +84,7 @@ def make_fedllm_seq_round(
     client_axis: str = "silos",
     seq_axis: str = "seq",
     attn: str = "ring",
+    inscan_quant: bool = False,
 ) -> Callable:
     """Long-context federated LoRA round over a (silos, seq) mesh.
 
@@ -96,6 +97,15 @@ def make_fedllm_seq_round(
 
     attn: "ring" (ppermute K/V rotation) or "ulysses" (all_to_all head
     scatter; needs n_heads % seq_size == 0).
+
+    inscan_quant: the long-context 7B layout — `model` must be
+    scan_layers=True and base_params the int8 tree (quant.quantize_tree_
+    int8); the forward is quant.make_inscan_quant_apply with the
+    sequence-parallel attention INSIDE the layer scan, so peak HBM stays
+    int8 base + ONE dense block + remat checkpoints while the token
+    dimension shards over `seq_axis`. This is the composition scale.py's
+    module-level path cannot express (flax nn.scan rejects a collective
+    inside the scanned block); the hand-written scan here can.
     """
     n_seq = mesh.shape[seq_axis]
     if attn == "ring":
@@ -108,15 +118,44 @@ def make_fedllm_seq_round(
         attn_fn = functools.partial(ulysses_attention, axis_name=seq_axis)
     else:
         raise ValueError(f"attn must be 'ring' or 'ulysses', got {attn!r}")
-    # same architecture, sequence-parallel attention bound to the mesh axis;
-    # compute_dtype honored like the flat path (mixed_precision_apply)
-    from ..models.hub import mixed_precision_apply
+    if inscan_quant:
+        from .quant import make_inscan_quant_apply
 
-    spmodel = TransformerLM(
-        vocab_size=model.vocab_size, d_model=model.d_model,
-        n_layers=model.n_layers, n_heads=model.n_heads, d_ff=model.d_ff,
-        attn_fn=attn_fn)
-    sp_apply = mixed_precision_apply(spmodel.apply, t.compute_dtype)
+        if not model.scan_layers:
+            raise ValueError(
+                "inscan_quant=True needs a TransformerLM(scan_layers=True) "
+                "model: the in-scan apply consumes the stacked "
+                "'blocks' param layout (per-block keys would KeyError deep "
+                "inside jit instead)")
+        if not (isinstance(base_params, dict) and "blocks" in base_params):
+            raise ValueError(
+                "inscan_quant=True needs base_params from a scan_layers "
+                "init (a top-level 'blocks' stack, optionally int8 via "
+                f"quant.quantize_tree_int8); got keys "
+                f"{sorted(base_params)[:6] if isinstance(base_params, dict) else type(base_params)}")
+        inscan_apply = make_inscan_quant_apply(
+            model.n_heads, attn_fn=attn_fn, alpha=alpha,
+            dtype=jnp.dtype(t.compute_dtype))
+
+        def sp_logits(base, a, x, off):
+            return inscan_apply(base, a, x, pos_offset=off).astype(
+                jnp.float32)
+    else:
+        # same architecture, sequence-parallel attention bound to the mesh
+        # axis; compute_dtype honored like the flat path
+        # (mixed_precision_apply)
+        from ..models.hub import mixed_precision_apply
+
+        spmodel = TransformerLM(
+            vocab_size=model.vocab_size, d_model=model.d_model,
+            n_layers=model.n_layers, n_heads=model.n_heads, d_ff=model.d_ff,
+            attn_fn=attn_fn)
+        sp_apply = mixed_precision_apply(spmodel.apply, t.compute_dtype)
+
+        def sp_logits(base, a, x, off):
+            merged = lora_merge(base, a, alpha)
+            return sp_apply({"params": merged}, x, pos_offset=off)
+
     opt = optax.sgd(t.learning_rate,
                     momentum=t.momentum if t.momentum else None)
 
@@ -134,9 +173,7 @@ def make_fedllm_seq_round(
             batch = {k: v[idx] for k, v in shard.items()}
 
             def loss_sum(a):
-                merged = lora_merge(base, a, alpha)
-                logits = sp_apply(
-                    {"params": merged}, batch["x"], pos_offset=off)
+                logits = sp_logits(base, a, batch["x"], off)
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, batch["y"])                       # [B, T_loc]
                 m = batch["mask"][:, None]

@@ -157,11 +157,24 @@ def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
     RMSNorm → SwiGLU; kernels bias-free) — the parity test pins the two
     implementations together (tests/test_fedllm_scale.py).
 
-    Returns apply(qparams, adapters, tokens) -> logits, where qparams is
-    quantize_tree_int8 of a TransformerLM(scan_layers=True) init and
-    adapters is llm.lora.lora_init of the same (stacked [L, ...] a/b).
+    Returns apply(qparams, adapters, tokens, pos_offset=0) -> logits, where
+    qparams is quantize_tree_int8 of a TransformerLM(scan_layers=True) init
+    and adapters is llm.lora.lora_init of the same (stacked [L, ...] a/b).
     Gradients w.r.t. adapters flow through the scan (per-layer slices are
     scanned inputs).
+
+    Ring-attention composition (the long-context 7B layout): pass
+    `attn_fn` bound to a seq mesh axis. Two verified forms:
+    - INSIDE a shard_map over (silos, seq): attn_fn =
+      functools.partial(parallel.seq.ring_attention, axis_name="seq") with
+      pos_offset = axis_index("seq") * T_local, so RoPE angles and the
+      causal mask use global positions (make_fedllm_seq_round
+      inscan_quant=True does this wiring);
+    - under a GSPMD jit: attn_fn = scale.make_ring_attn_fn(mesh, ...) — a
+      shard_map ISLAND per scan step; tokens stay global so the default
+      pos_offset=0 is correct. The hand-written lax.scan body sidesteps
+      the flax nn.scan broadcast-constant limitation that forbids
+      scan_layers x seq in the module-level path (scale.py).
     """
     from ..parallel.seq import dense_causal_attention
     from .transformer import rope
@@ -183,7 +196,7 @@ def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
             w = w + rank_scale * (a["a"] @ a["b"]).astype(w.dtype)
         return w
 
-    def apply(qparams, adapters, tokens):
+    def apply(qparams, adapters, tokens, pos_offset=0):
         rank = next(iter(adapters.values()))["a"].shape[-1]
         rank_scale = alpha / rank
         # split adapters into stacked per-block slices vs top-level ones
@@ -193,7 +206,7 @@ def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
                    if not k.startswith("blocks/")}
         emb = dq(qparams["embed"]["embedding"])
         x = emb[tokens]
-        pos = jnp.arange(tokens.shape[1])
+        pos = pos_offset + jnp.arange(tokens.shape[1])
 
         def body(x, layer):
             bl, ad_l = layer

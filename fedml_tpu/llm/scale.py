@@ -19,6 +19,19 @@ Sharded base checkpointing: save_base_sharded/restore_base_sharded write the
 TP-sharded base through orbax — each host stores its shards, and restore
 targets the SAME mesh layout, so a multi-chip base never funnels through one
 host's RAM.
+
+THREE verified program layouts (each parity/dryrun-tested —
+tests/test_fedllm_scale.py, __graft_entry__.py):
+1. unrolled blocks + ring attention (scan_layers=False, seq axis) — the
+   long-context layout for models whose unrolled HLO compiles;
+2. scan-layers + TP + dp (scan_layers=True, seq_axis=None) — the deep-model
+   layout; O(1)-in-depth HLO, attention per-chip;
+3. scan-layers + int8 base + ring attention (scan_layers=True,
+   quantize_base=True, seq axis) — the long-context DEEP layout: quant.
+   make_inscan_quant_apply's hand-written lax.scan dequantizes one layer
+   per step and carries the attention island, which flax nn.scan's
+   broadcast-constant tracing cannot (the layout the 7B-across-silos-at-
+   long-T north star needs; BASELINE.md workload 5).
 """
 from __future__ import annotations
 
@@ -44,7 +57,18 @@ def make_ring_attn_fn(mesh: Mesh, seq_axis: str = "seq",
     shard_map island inside the surrounding GSPMD jit. q/k/v arrive as
     GLOBAL [B, T, H, D] arrays (RoPE already applied globally); the island
     re-shards them (B over dp, T over seq, H over tp), rotates K/V around
-    the seq ring, and hands the global result back to GSPMD."""
+    the seq ring, and hands the global result back to GSPMD. Pass
+    dp_axis/tp_axis=None to leave that dimension unsharded (e.g. a
+    (silos, seq) federated mesh uses dp_axis='silos', tp_axis=None); an
+    axis NAME that is not in the mesh is an error, not a silent
+    replication — a quietly-dropped dp axis would make every seq ring
+    group redundantly attend over the GLOBAL batch."""
+    for what, ax in (("seq_axis", seq_axis), ("dp_axis", dp_axis),
+                     ("tp_axis", tp_axis)):
+        if ax is not None and ax not in mesh.axis_names:
+            raise ValueError(
+                f"{what}={ax!r} is not an axis of mesh {mesh.axis_names}; "
+                f"pass {what}=None to leave that dimension unsharded")
     spec = P(dp_axis, seq_axis, tp_axis, None)
 
     ring = shard_map(
@@ -88,20 +112,30 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
     # a mesh without the seq axis degrades to dense attention AND an
     # unsharded sequence dim — both guards must agree on mesh membership
     has_seq = bool(seq_axis) and seq_axis in mesh.axis_names
-    if scan_layers and has_seq:
+    inscan = scan_layers and has_seq
+    if inscan and not quantize_base:
         raise ValueError(
-            "scan_layers does not compose with the ring-attention seq axis: "
-            "flax nn.scan's broadcast-constant tracing rejects a shard_map "
+            "scan_layers composes with the ring-attention seq axis only "
+            "through the int8 in-scan path (quantize_base=True): flax "
+            "nn.scan's broadcast-constant tracing rejects a shard_map "
             "island inside the scanned block ('broadcasted variable has a "
-            "data dependency on the scan body'). Pick one: seq_axis=None "
-            "(scan + TP + dp — the deep-model layout; attention stays "
-            "per-chip) or scan_layers=False (unrolled blocks + ring "
-            "attention — the long-context layout).")
-    attn = (make_ring_attn_fn(mesh, seq_axis=seq_axis, dp_axis=dp_axis)
+            "data dependency on the scan body'), but quant.make_inscan_"
+            "quant_apply's hand-written lax.scan accepts one. Pick one: "
+            "quantize_base=True (in-scan int8 + ring — the long-context "
+            "deep-model layout), seq_axis=None (scan + TP + dp; attention "
+            "stays per-chip), or scan_layers=False (unrolled blocks + ring "
+            "attention).")
+    attn = (make_ring_attn_fn(
+        mesh, seq_axis=seq_axis, dp_axis=dp_axis,
+        tp_axis="tp" if "tp" in mesh.axis_names else None)
             if has_seq else None)
+    # inscan: the flax module is NOT the forward (its nn.scan would reject
+    # the attention island) — quant.make_inscan_quant_apply is; the module
+    # is still returned for metadata/eval, with per-chip dense attention
     model = model_cls(vocab_size=vocab_size, d_model=d_model,
                       n_layers=n_layers, n_heads=n_heads, d_ff=d_ff,
-                      attn_fn=attn, remat=True, scan_layers=scan_layers)
+                      attn_fn=None if inscan else attn, remat=True,
+                      scan_layers=scan_layers)
     # init DIRECTLY into the TP layout: jit the initializer with its output
     # shardings set to the Megatron specs, so each device materializes only
     # its own shard — the full base never exists replicated anywhere
@@ -134,6 +168,12 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
     batch_spec = NamedSharding(
         mesh, P(dp_axis, seq_axis if has_seq else None))
 
+    if inscan:
+        from .quant import make_inscan_quant_apply
+
+        inscan_apply = make_inscan_quant_apply(
+            n_heads, attn_fn=attn, alpha=alpha, dtype=dtype)
+
     # base rides as a jit ARGUMENT: closing over a multi-GB pytree captures
     # it as lowering constants (minutes of extra compile at the 1B scale)
     @jax.jit
@@ -142,10 +182,16 @@ def build_scaled_fedllm(model_cls, mesh: Mesh, *, vocab_size: int,
         targets = jax.lax.with_sharding_constraint(targets, batch_spec)
 
         def loss_fn(ad):
-            dense_base = (dequantize_tree(base, dtype) if quantize_base
-                          else base)
-            merged = lora_merge(dense_base, ad, alpha)
-            logits = model.apply({"params": merged}, tokens)
+            if inscan:
+                # int8 base dequantized one layer at a time INSIDE the scan,
+                # ring attention as a shard_map island per scan step —
+                # tokens stay global, so RoPE's default positions are right
+                logits = inscan_apply(base, ad, tokens)
+            else:
+                dense_base = (dequantize_tree(base, dtype) if quantize_base
+                              else base)
+                merged = lora_merge(dense_base, ad, alpha)
+                logits = model.apply({"params": merged}, tokens)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
             ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
             return -ll.mean()

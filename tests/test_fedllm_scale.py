@@ -203,14 +203,16 @@ def test_scaled_fedllm_scan_int8_full_composition():
     base x stacked scan-layers x replicated LoRA x ring attention x remat,
     one jit over the (dp, tp, seq) mesh — loss finite and close to the
     dense full-precision reference, adapters train, base stays int8 and
-    TP-sharded. scan_layers + the ring seq axis is an explicit non-combo
-    (flax nn.scan rejects shard_map islands in the scanned body), so the
-    deep-model layout runs on a (dp, tp) mesh with per-chip attention."""
-    with pytest.raises(ValueError, match="scan_layers does not compose"):
+    TP-sharded. scan_layers + the ring seq axis WITHOUT int8 is an explicit
+    non-combo (flax nn.scan rejects shard_map islands in the scanned body);
+    with quantize_base=True the in-scan path carries it (tested below), so
+    here the deep-model layout runs on a (dp, tp) mesh with per-chip
+    attention."""
+    with pytest.raises(ValueError, match="only .*through the int8 in-scan"):
         build_scaled_fedllm(
             TransformerLM, make_mesh({"dp": 2, "tp": 2, "seq": 2}),
             vocab_size=VOCAB, d_model=D, n_layers=L, n_heads=H, d_ff=256,
-            scan_layers=True, quantize_base=True)
+            scan_layers=True, quantize_base=False)
 
     mesh = make_mesh({"dp": 2, "tp": 4})
     # d_model >= 64 so the stacked kernels cross the (kernel-like) int8
@@ -304,6 +306,114 @@ def test_inscan_quant_apply_matches_module_and_trains():
     assert losses[-1] < losses[0] - 0.1, losses
 
 
+def test_inscan_ring_island_matches_dense():
+    """Round-4 verdict #2: the long-context DEEP layout — scan-layers x
+    int8 base x ring attention — composed under one GSPMD jit via
+    build_scaled_fedllm(scan_layers=True, quantize_base=True, seq axis).
+    quant.make_inscan_quant_apply's hand-written lax.scan carries the
+    shard_map attention island that flax nn.scan rejects. Parity: the step
+    loss must match the DENSE per-chip scan module on the same dequantized
+    base, and adapters must train."""
+    from fedml_tpu.llm.quant import dequantize_tree
+
+    mesh = make_mesh({"dp": 2, "tp": 2, "seq": 2})
+    # T=16 divisible by |seq|=2; d_model 64 crosses the int8 size threshold
+    model, base, adapters, step = build_scaled_fedllm(
+        TransformerLM, mesh, vocab_size=VOCAB, d_model=64, n_layers=3,
+        n_heads=H, d_ff=256, rank=4, lr=0.5, compute_dtype="float32",
+        scan_layers=True, quantize_base=True)
+    blk = base["blocks"]["w_gate"]["kernel"]
+    assert set(blk) == {"q", "s"} and blk["q"].dtype == jnp.int8
+    assert "tp" in str(blk["q"].sharding.spec)
+
+    rs = np.random.RandomState(0)
+    seqs = (rs.randint(0, VOCAB, (4, 1)) + np.arange(T + 1)) % VOCAB
+    x = jnp.asarray(seqs[:, :-1], jnp.int32)
+    y = jnp.asarray(seqs[:, 1:], jnp.int32)
+
+    dense_model = TransformerLM(vocab_size=VOCAB, d_model=64, n_layers=3,
+                                n_heads=H, d_ff=256, scan_layers=True)
+    deq = jax.tree.map(np.asarray, dequantize_tree(base, jnp.float32))
+    ref_apply = lora_apply_fn(dense_model.apply, deq)
+    logits = ref_apply({"params": adapters}, x)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ref_loss = -jnp.take_along_axis(logp, y[..., None], -1).mean()
+
+    ad, loss1 = step(adapters, x, y)
+    assert abs(float(loss1) - float(ref_loss)) < 1e-2, (loss1, ref_loss)
+    losses = [float(loss1)]
+    for _ in range(8):
+        ad, l = step(ad, x, y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_fedllm_seq_round_inscan_quant_parity():
+    """Round-4 verdict #2(a): the FEDERATED long-context 7B program shape —
+    make_fedllm_seq_round(inscan_quant=True) on a (silos, seq) mesh, int8
+    scan base, ring attention INSIDE the layer scan. Parity: the same
+    round on a (silos, seq=1) mesh (ring of one == dense, full T local)
+    must produce the same trained adapters and loss."""
+    from fedml_tpu.config import TrainArgs
+    from fedml_tpu.core.algorithm import ServerState
+    from fedml_tpu.llm import make_fedllm_seq_round, shard_fedllm_data
+    from fedml_tpu.llm.lora import lora_init
+    from fedml_tpu.llm.quant import quantize_tree_int8
+
+    V2, D2, L2, H2, FF2 = 128, 64, 3, 4, 256
+    n_silos, n_seqs, t_len = 2, 4, 16
+    model = TransformerLM(vocab_size=V2, d_model=D2, n_layers=L2,
+                          n_heads=H2, d_ff=FF2, scan_layers=True)
+    base = model.init(jax.random.key(0),
+                      jnp.zeros((1, t_len), jnp.int32))["params"]
+    qbase = quantize_tree_int8(base)
+    t = TrainArgs(epochs=1, batch_size=2, learning_rate=0.5,
+                  compute_dtype="float32")
+    rs = np.random.RandomState(0)
+    seqs = (rs.randint(1, V2, (n_silos, n_seqs, 1))
+            + np.arange(t_len + 1)) % V2
+    raw = {"x": seqs[:, :, :-1], "y": seqs[:, :, 1:],
+           "mask": np.ones((n_silos, n_seqs), np.float32)}
+    ids = jnp.arange(n_silos)
+    w = jnp.full((n_silos,), float(n_seqs))
+
+    def run(mesh):
+        adapters = lora_init(jax.random.key(1), base, rank=4)
+        rnd = make_fedllm_seq_round(model, qbase, t, mesh,
+                                    inscan_quant=True)
+        data = shard_fedllm_data(raw, mesh)
+        st = ServerState(adapters, None, jnp.int32(0), None)
+        st, m = rnd(st, qbase, data, ids, w, jax.random.key(2))
+        st, m = rnd(st, qbase, data, ids, w, jax.random.key(3))
+        return jax.device_get(st.params), float(m["train_loss"])
+
+    # precondition guards fail loudly, not deep inside jit tracing
+    with pytest.raises(ValueError, match="scan_layers=True"):
+        make_fedllm_seq_round(
+            TransformerLM(vocab_size=V2, d_model=D2, n_layers=L2,
+                          n_heads=H2, d_ff=FF2),
+            qbase, t, make_mesh({"silos": 2, "seq": 4}), inscan_quant=True)
+    with pytest.raises(ValueError, match="'blocks' stack|blocks"):
+        make_fedllm_seq_round(
+            model, {"block_0": {}}, t, make_mesh({"silos": 2, "seq": 4}),
+            inscan_quant=True)
+
+    ad_ring, loss_ring = run(make_mesh({"silos": n_silos, "seq": 4}))
+    ad_ref, loss_ref = run(make_mesh({"silos": n_silos, "seq": 1}))
+    assert np.isfinite(loss_ring)
+    assert abs(loss_ring - loss_ref) < 1e-3, (loss_ring, loss_ref)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3),
+        ad_ring, ad_ref)
+    # adapters actually moved off their init
+    init = lora_init(jax.random.key(1), base, rank=4)
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        ad_ring, jax.device_get(init)))
+    assert max(moved) > 1e-4, moved
+
+
 def test_quantized_base_sharded_checkpoint_roundtrip(tmp_path):
     """The int8 TP-sharded base round-trips through the sharded orbax
     checkpoint path (save_base_sharded / restore_base_sharded) — the 7B
@@ -330,3 +440,19 @@ def test_quantized_base_sharded_checkpoint_roundtrip(tmp_path):
     blk = got["blocks"]["wq"]["kernel"]
     assert blk["q"].dtype == jnp.int8
     assert "tp" in str(blk["q"].sharding.spec)
+
+
+def test_make_ring_attn_fn_rejects_absent_axes():
+    """A dp/tp axis name missing from the mesh must fail loudly — silently
+    dropping dp would make every seq ring group attend over the GLOBAL
+    batch (n-fold redundant compute) with no error."""
+    mesh = make_mesh({"silos": 2, "seq": 4})
+    from fedml_tpu.llm.scale import make_ring_attn_fn
+
+    with pytest.raises(ValueError, match="dp_axis='dp' is not an axis"):
+        make_ring_attn_fn(mesh)                       # default dp_axis="dp"
+    with pytest.raises(ValueError, match="tp_axis"):
+        make_ring_attn_fn(mesh, dp_axis="silos")      # default tp_axis="tp"
+    # explicit Nones accept the federated (silos, seq) mesh
+    make_ring_attn_fn(mesh, dp_axis="silos", tp_axis=None)
+    make_ring_attn_fn(mesh, dp_axis=None, tp_axis=None)
