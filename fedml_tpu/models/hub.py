@@ -203,6 +203,17 @@ def _darts(num_classes, **kw):
 MODELS.register("darts")(_darts)
 
 
+def _unet(num_classes, **kw):
+    from .seg import UNetLite
+
+    return UNetLite(num_classes, **kw)
+
+
+# reference: simulation/mpi/fedseg trains DeepLab/UNet-family dense
+# predictors; pairs with the "segmentation" objective (core/algorithm.py)
+MODELS.register("unet")(_unet)
+
+
 def create(model_name: str, num_classes: int, **kwargs) -> nn.Module:
     """fedml.model.create equivalent (reference: model/model_hub.py:19)."""
     return MODELS.get(model_name)(num_classes=num_classes, **kwargs)

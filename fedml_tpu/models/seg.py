@@ -1,0 +1,71 @@
+"""Semantic-segmentation model family (FedSeg runtime parity).
+
+(reference: python/fedml/simulation/mpi/fedseg/FedSegAPI.py:1 — the FedSeg
+runtime trains DeepLabV3+/UNet-family torch models with a per-pixel CE
+objective and evaluates mIoU; its ~1,150 LoC are MPI orchestration around
+an ordinary dense-prediction task. Here the round engine is task-agnostic,
+so FedSeg = a segmentation model in the hub + the `segmentation` objective
+in core/algorithm.py OBJECTIVES + mIoU in the eval plumbing.)
+
+TPU-first choices:
+- UNet-lite encoder/decoder: 3x3 convs (MXU-tiled), GroupNorm (BatchNorm
+  running stats don't federate — same reasoning as models/hub.py), and
+  `jax.image.resize` bilinear upsampling + conv instead of transposed
+  convs (resize+conv lowers to one fused XLA op chain and avoids the
+  checkerboard artifacts transposed convs need care to dodge).
+- All shapes static: input [B, H, W, C] -> logits [B, H, W, num_classes];
+  H/W must be divisible by 2**len(features) (pinned by an init-time check,
+  not a runtime branch, so jit sees one static program).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _ConvBlock(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), use_bias=False)(x)
+            x = nn.relu(nn.GroupNorm(
+                num_groups=min(8, self.features))(x))
+        return x
+
+
+class UNetLite(nn.Module):
+    """Small UNet: encoder (conv blocks + 2x2 maxpool), bottleneck, decoder
+    (bilinear upsample + skip concat + conv block), 1x1 classifier head.
+
+    Sized for federated experiments (three levels, ~0.5M params at the
+    default widths); `features` widens it to a real UNet when needed.
+    """
+    num_classes: int
+    features: Sequence[int] = (16, 32)
+    bottleneck: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        down = 2 ** len(self.features)
+        if x.shape[1] % down or x.shape[2] % down:
+            raise ValueError(
+                f"UNetLite input H/W {x.shape[1:3]} must be divisible by "
+                f"{down} (len(features)={len(self.features)} pool levels)")
+        skips = []
+        for f in self.features:
+            x = _ConvBlock(f)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = _ConvBlock(self.bottleneck)(x)
+        for f, skip in zip(reversed(self.features), reversed(skips)):
+            x = jax.image.resize(
+                x, x.shape[:1] + skip.shape[1:3] + x.shape[-1:],
+                method="bilinear")
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = _ConvBlock(f)(x)
+        return nn.Conv(self.num_classes, (1, 1))(x)

@@ -1,0 +1,122 @@
+"""FedSeg runtime parity: segmentation model + per-pixel objective + mIoU
+(reference: python/fedml/simulation/mpi/fedseg/FedSegAPI.py:1 — DeepLab/UNet
+training with CE(ignore_index=255) and Evaluator.Mean_Intersection_over_
+Union; here the task-agnostic round engine carries it with a `segmentation`
+objective and a UNet-lite hub model)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.builtin import make_fedavg
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.core.algorithm import (
+    SEG_IGNORE_ID, make_objective, miou_from_logits, seg_softmax_ce,
+)
+from fedml_tpu.models import hub
+from fedml_tpu.parallel.round import build_round_fn
+
+
+def _square_dataset(rs, n_clients, s, hw=16, ignore_frac=0.02):
+    """Images with one bright axis-aligned square; label 1 inside it,
+    0 outside, a sprinkle of 255-ignore pixels."""
+    x = 0.1 * rs.randn(n_clients, s, hw, hw, 1).astype(np.float32)
+    y = np.zeros((n_clients, s, hw, hw), np.int32)
+    for c in range(n_clients):
+        for i in range(s):
+            h0, w0 = rs.randint(1, hw // 2, 2)
+            sz = rs.randint(3, hw // 2)
+            x[c, i, h0:h0 + sz, w0:w0 + sz, 0] += 1.0
+            y[c, i, h0:h0 + sz, w0:w0 + sz] = 1
+    ign = rs.rand(*y.shape) < ignore_frac
+    y = np.where(ign, SEG_IGNORE_ID, y)
+    return x, y
+
+
+def test_unet_forward_shape_and_divisibility_guard():
+    model = hub.create("unet", 3)
+    params = hub.init_params(model, (16, 16, 1), jax.random.key(0))
+    out = model.apply({"params": params}, jnp.zeros((2, 16, 16, 1)))
+    assert out.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    with pytest.raises(ValueError, match="divisible"):
+        model.apply({"params": params}, jnp.zeros((1, 10, 10, 1)))
+
+
+def test_seg_objective_ignores_255_and_padded_rows():
+    # 1x2x2 "image", one ignore pixel, plus a fully-padded second sample
+    logits = jnp.asarray([
+        [[[5.0, -5.0], [5.0, -5.0]], [[-5.0, 5.0], [5.0, -5.0]]],
+        [[[5.0, -5.0], [5.0, -5.0]], [[5.0, -5.0], [5.0, -5.0]]],
+    ])                                           # [2, 2, 2, 2]
+    y = jnp.asarray([
+        [[0, SEG_IGNORE_ID], [1, 1]],
+        [[0, 0], [0, 0]],
+    ])
+    mask = jnp.asarray([1.0, 0.0])
+    loss, correct, cnt = seg_softmax_ce(logits, y, mask)
+    # 3 valid pixels (4 - 1 ignore), padded sample contributes nothing
+    assert float(cnt) == 3.0
+    # pred = [[0,0],[1,0]]; valid y = [0,-,1,1] -> correct on (0,0),(1,0)
+    assert float(correct) == 2.0
+    assert float(loss) > 0
+    assert make_objective("segmentation") is seg_softmax_ce
+
+
+def test_miou_matches_hand_count():
+    # pred classes: [[0,1],[1,1]]; y: [[0,0],[1,ignore]]
+    logits = jnp.asarray(
+        [[[[5.0, -5.0], [-5.0, 5.0]], [[-5.0, 5.0], [-5.0, 5.0]]]])
+    y = jnp.asarray([[[0, 0], [1, SEG_IGNORE_ID]]])
+    miou, iou = miou_from_logits(logits, y, num_classes=2)
+    # class 0: inter 1, union 2 -> 0.5 ; class 1: inter 1, union 2 -> 0.5
+    np.testing.assert_allclose(np.asarray(iou), [0.5, 0.5], atol=1e-6)
+    np.testing.assert_allclose(float(miou), 0.5, atol=1e-6)
+    # a class absent from pred AND target is excluded from the mean
+    miou3, iou3 = miou_from_logits(logits, y, num_classes=3)
+    np.testing.assert_allclose(float(miou3), 0.5, atol=1e-6)
+    assert float(iou3[2]) == 0.0
+
+
+def test_segmentation_federated_round_e2e():
+    """One full federated FedSeg setup on synthetic masks: FedAvg over a
+    UNet-lite, per-pixel CE with ignore pixels, loss drops, pixel accuracy
+    and mIoU end up high — the e2e row that flips the FedSeg by-design
+    exclusion to implemented."""
+    rs = np.random.RandomState(0)
+    n, s = 3, 16
+    x, y = _square_dataset(rs, n, s)
+    data = {"x": jnp.asarray(x), "y": jnp.asarray(y),
+            "mask": jnp.ones((n, s), jnp.float32)}
+    model = hub.create("unet", 2)
+    t = TrainArgs(epochs=1, batch_size=8, learning_rate=0.2,
+                  extra={"task": "segmentation"})
+    alg = make_fedavg(model.apply, t)
+    params = hub.init_params(model, (16, 16, 1), jax.random.key(0))
+    rnd = build_round_fn(alg, mesh=None)
+    st = alg.server_init(params, None)
+    losses, accs = [], []
+    for r in range(6):
+        out = rnd(st, jnp.zeros((n,)), data,
+                  jnp.arange(n), jnp.full((n,), float(s)),
+                  jax.random.fold_in(jax.random.key(1), r), None)
+        st = out.server_state
+        losses.append(float(out.metrics["train_loss"]))
+        accs.append(float(out.metrics["train_acc"]))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert accs[-1] > 0.9, accs
+    # eval plumbing: the batched seg evaluator reports loss/acc/mIoU over
+    # the whole set (confusion matrix accumulated across batches)
+    from fedml_tpu.core.algorithm import seg_eval_fn
+
+    xe, ye = _square_dataset(np.random.RandomState(7), 1, 8)
+    ev = seg_eval_fn(model.apply, num_classes=2)
+    out = ev(st.params, jnp.asarray(xe[0]).reshape(2, 4, 16, 16, 1),
+             jnp.asarray(ye[0]).reshape(2, 4, 16, 16),
+             jnp.ones((2, 4), jnp.float32))
+    assert float(out["miou"]) > 0.6, out
+    assert float(out["acc"]) > 0.85, out
+    # batched-eval mIoU agrees with the one-shot helper on the same data
+    logits = model.apply({"params": st.params}, jnp.asarray(xe[0]))
+    miou1, _ = miou_from_logits(logits, jnp.asarray(ye[0]), num_classes=2)
+    np.testing.assert_allclose(float(out["miou"]), float(miou1), atol=1e-5)
