@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+from .export import export_model, load_export, predictor_from_export
 from .inference_runner import DEFAULT_PORT, FedMLInferenceRunner
 from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
 
@@ -21,6 +22,7 @@ __all__ = [
     "Predictor", "JaxPredictor", "GreedyLMPredictor",
     "FedMLInferenceRunner", "DEFAULT_PORT", "serve_simulator",
     "predictor_from_checkpoint", "predictor_from_artifact",
+    "export_model", "load_export", "predictor_from_export",
 ]
 
 

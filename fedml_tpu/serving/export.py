@@ -1,0 +1,182 @@
+"""Framework-neutral model export — the deploy pipeline's interchange format.
+
+(reference: computing/scheduler/model_scheduler/device_model_deployment.py
+:720 `convert_model_to_onnx` + :172,263 — the reference's deploy path
+converts trained torch models to ONNX and lays out a Triton model
+repository so serving does not depend on the training framework. The
+TPU-native analog is a flat-tensor manifest: jax/flax adds nothing to an
+inference contract that is just named arrays + a model recipe, and a flat
+npz is readable by ANY consumer with a numpy-compatible loader — torch,
+TF, C++ via cnpy, or a Triton python backend.)
+
+LAYOUT CONTRACT (format "fedml-tpu-export/1"):
+
+    <export_dir>/
+      manifest.json      UTF-8 JSON, two sections:
+        "format":  "fedml-tpu-export/1"
+        "tensors": {flat_name: {"shape": [ints], "dtype": numpy-name,
+                    "cast_from": original-dtype (only when the stored
+                    dtype differs, e.g. bfloat16 stored as float32)}}
+        "model":   optional recipe {"model": hub name, "num_classes": int,
+                   "model_args": {...}, "input_shape": [ints],
+                   "compute_dtype": str} — enough for
+                   predictor_from_export to rebuild the apply_fn
+      tensors.npz        numpy zip archive; one entry per manifest tensor,
+                         SAME flat names, row-major (C-order) arrays
+
+Flat names are the "/"-joined path through the params pytree
+("block_0/wq/kernel"), so the nested tree round-trips losslessly and a
+non-JAX consumer sees self-describing names. Tensors not representable in
+portable npz (bfloat16) are stored as float32 and flagged via "cast_from";
+load_export restores the original dtype.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+Pytree = Any
+
+FORMAT = "fedml-tpu-export/1"
+_MANIFEST = "manifest.json"
+_TENSORS = "tensors.npz"
+
+
+def _flatten(params: Pytree, prefix: str = "") -> dict:
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+        return out
+    out[prefix[:-1]] = np.asarray(params)
+    return out
+
+
+def _unflatten(flat: dict) -> Pytree:
+    tree: dict = {}
+    for name, v in flat.items():
+        node = tree
+        *parents, leaf = name.split("/")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[leaf] = v
+    return tree
+
+
+def export_model(path: str, params: Pytree,
+                 model_name: Optional[str] = None,
+                 num_classes: Optional[int] = None,
+                 model_args: Optional[dict] = None,
+                 input_shape: Optional[tuple] = None,
+                 compute_dtype: str = "float32") -> dict:
+    """Write the flat-tensor export (layout contract above). Returns the
+    manifest dict. `model_name` etc. are optional — without them the export
+    is a pure tensor interchange; with them predictor_from_export can
+    rebuild a live predictor."""
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(jax_device_get(params))
+    tensors, table = {}, {}
+    for name, arr in sorted(flat.items()):
+        entry = {"shape": [int(d) for d in arr.shape],
+                 "dtype": str(arr.dtype)}
+        # portable = a dtype any stock-numpy reader parses (bool/int/uint/
+        # float/complex); bfloat16 & friends register with kind 'V'
+        if arr.dtype.kind not in "biufc":   # store widened, flag it
+            entry["cast_from"] = str(arr.dtype)
+            arr = arr.astype(np.float32)
+            entry["dtype"] = "float32"
+        tensors[name] = np.ascontiguousarray(arr)
+        table[name] = entry
+    manifest = {"format": FORMAT, "tensors": table}
+    if model_name is not None:
+        if num_classes is None:
+            raise ValueError(
+                "export_model: model_name without num_classes would write a "
+                "manifest whose model recipe disagrees with the exported "
+                "head tensors; pass the model's num_classes explicitly")
+        manifest["model"] = {
+            "model": model_name,
+            "num_classes": int(num_classes),
+            "model_args": dict(model_args or {}),
+            "compute_dtype": compute_dtype,
+        }
+        if input_shape is not None:
+            manifest["model"]["input_shape"] = [int(d) for d in input_shape]
+    np.savez(os.path.join(path, _TENSORS), **tensors)
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def jax_device_get(params: Pytree) -> Pytree:
+    """Host numpy view of a (possibly device-resident, possibly sharded)
+    pytree; plain numpy trees pass through untouched."""
+    try:
+        import jax
+
+        return jax.tree.map(np.asarray, jax.device_get(params))
+    except ImportError:  # pure-numpy consumer of this module
+        return params
+
+
+def load_export(path: str) -> tuple[Pytree, dict]:
+    """(params_pytree, manifest) from an export dir. Validates the format
+    tag and every tensor's shape/dtype against the manifest — a truncated
+    or hand-edited artifact fails loudly, not at inference time."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT:
+        raise ValueError(
+            f"{path!r} is not a {FORMAT} export "
+            f"(format={manifest.get('format')!r})")
+    with np.load(os.path.join(path, _TENSORS)) as z:
+        names = set(z.files)
+        want = set(manifest["tensors"])
+        if names != want:
+            raise ValueError(
+                f"export {path!r} tensor set mismatch: manifest has "
+                f"{sorted(want - names)[:4]} missing, archive has "
+                f"{sorted(names - want)[:4]} extra")
+        flat = {}
+        for name, entry in manifest["tensors"].items():
+            arr = z[name]
+            if list(arr.shape) != entry["shape"] or \
+                    str(arr.dtype) != entry["dtype"]:
+                raise ValueError(
+                    f"tensor {name!r} does not match its manifest entry: "
+                    f"archive {arr.shape}/{arr.dtype} vs manifest "
+                    f"{entry['shape']}/{entry['dtype']}")
+            src = entry.get("cast_from")
+            if src:
+                try:
+                    import ml_dtypes  # noqa: F401 — registers bfloat16
+
+                    arr = arr.astype(np.dtype(src))
+                except (ImportError, TypeError):
+                    pass   # numpy-only consumer keeps the widened dtype
+            flat[name] = arr
+    return _unflatten(flat), manifest
+
+
+def predictor_from_export(path: str, return_probs: bool = True):
+    """Live JaxPredictor from an export that carries a model recipe —
+    the serving-side load-back (counterpart of predictor_from_artifact,
+    reference: device_model_deployment.py model-package unpack)."""
+    from ..models import hub as model_hub
+    from .predictor import JaxPredictor
+
+    params, manifest = load_export(path)
+    spec = manifest.get("model")
+    if not spec:
+        raise ValueError(
+            f"export {path!r} has no 'model' recipe — it is a pure tensor "
+            "interchange; pass model_name/num_classes to export_model to "
+            "make it servable")
+    model = model_hub.create(spec["model"], int(spec["num_classes"]),
+                             **dict(spec.get("model_args", {})))
+    apply_fn = model_hub.mixed_precision_apply(
+        model.apply, spec.get("compute_dtype", "float32"))
+    return JaxPredictor(apply_fn, params, return_probs=return_probs)

@@ -46,6 +46,10 @@ R_DEAD = "DEAD"
 def start_replica(spec: dict):
     """Worker-side: build a predictor from a deployment spec and serve it.
     Spec sources (first match wins):
+      - "export_dir": framework-neutral flat-tensor export (serving/
+        export.py — the reference's ONNX/Triton model-repo analog,
+        device_model_deployment.py:720 convert_model_to_onnx); the export's
+        own manifest carries the model recipe, so no other spec keys needed
       - "checkpoint_dir": orbax checkpoint from utils/checkpoint.py
       - "params": inline pytree of ndarrays (rides the tensor wire format)
     plus "model"/"num_classes"/"input_shape"/"model_args" to rebuild the
@@ -55,6 +59,14 @@ def start_replica(spec: dict):
     from ..models import hub as model_hub
     from .inference_runner import FedMLInferenceRunner
     from .predictor import JaxPredictor
+
+    if spec.get("export_dir"):
+        from .export import predictor_from_export
+
+        pred = predictor_from_export(spec["export_dir"])
+        runner = FedMLInferenceRunner(pred, port=int(spec.get("port", 0)))
+        runner.start()
+        return uuid.uuid4().hex[:10], runner
 
     model = model_hub.create(spec["model"], int(spec.get("num_classes", 10)),
                              **dict(spec.get("model_args", {})))
