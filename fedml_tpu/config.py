@@ -275,8 +275,10 @@ class Config:
 
 
 # flat override key -> owning section, for reference-style flat silo
-# overrides (train_args listed first so its names win any collision, which
-# preserves the common case: batch_size/learning_rate/... are train knobs)
+# overrides. train_args is listed LAST: later dict writes overwrite earlier
+# ones, so its field names win any collision — which preserves the common
+# case: batch_size/learning_rate/... are train knobs. (Reordering this
+# tuple silently changes flat-key routing; test_config_silo pins it.)
 _FLAT_KEY_SECTION: dict = {}
 for _section in ("dp_args", "security_args", "tracking_args", "comm_args",
                  "device_args", "validation_args", "model_args", "data_args",

@@ -29,10 +29,13 @@ Formats:
 from __future__ import annotations
 
 import csv as _csv
+import logging
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from .fed_dataset import FedDataset, pack_client_shards
 
@@ -179,6 +182,16 @@ def landmarks_csv(name: str, cache_dir: Path, cfg) -> Optional[FedDataset]:
             f"client_num_in_total={want}; lower the config to the file's "
             "client count")
     users = sorted(by_user)[:want]
+    if len(by_user) > want:
+        # the reference uses EVERY user (natural partition, pinned counts
+        # 233/1262 — ref data/Landmarks/data_loader.py); truncating is a
+        # config choice, so say exactly what is being dropped
+        dropped = sum(len(by_user[u]) for u in sorted(by_user)[want:])
+        log.warning(
+            "%s: mapping file has %d users but client_num_in_total=%d — "
+            "keeping the first %d (sorted) and DROPPING %d users / %d "
+            "samples; raise client_num_in_total to use every user",
+            name, len(by_user), want, want, len(by_user) - want, dropped)
     xs, ys, parts, off = [], [], [], 0
     for u in users:
         for r in by_user[u]:

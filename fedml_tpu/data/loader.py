@@ -330,12 +330,32 @@ def _leaf_shakespeare(cache_dir: Path, cfg: Config) -> FedDataset | None:
                               pad_multiple=cfg.train_args.batch_size)
 
 
+# Token-dataset cache format version. v2 = the +1 vocab shift that reserves
+# id 0 for pad (round-4 NWP parity fix): a pre-shift cache encodes '\n' as 0,
+# which the nwp objective would now silently EXCLUDE from loss/metrics —
+# reinterpreting old ids is a correctness bug, so unversioned/old token
+# caches are rejected, not reinterpreted (round-4 advisor).
+_TOKEN_CACHE_VERSION = 2
+
+
 def _npz_dataset(name: str, cache_dir: Path, cfg: Config) -> FedDataset | None:
-    """Generic pre-exported npz: {name}.npz with x_train/y_train/x_test/y_test."""
+    """Generic pre-exported npz: {name}.npz with x_train/y_train/x_test/y_test.
+    Token datasets additionally need `vocab_version == _TOKEN_CACHE_VERSION`
+    in the archive (see _TOKEN_CACHE_VERSION above)."""
     f = cache_dir / f"{name}.npz"
     if not f.is_file():
         return None
     blob = np.load(f)
+    if name in _TOKEN_TASKS:
+        ver = int(blob["vocab_version"]) if "vocab_version" in blob else None
+        if ver != _TOKEN_CACHE_VERSION:
+            raise ValueError(
+                f"{f} was exported with token-vocab version {ver} but this "
+                f"build expects {_TOKEN_CACHE_VERSION} (id 0 is now a "
+                "reserved pad excluded from NWP loss; old caches encode a "
+                "real character as 0). Re-export the dataset with "
+                f"vocab_version={_TOKEN_CACHE_VERSION} in the npz instead "
+                "of silently reinterpreting old ids.")
     shape, num_classes = DATASET_SHAPES.get(name, (None, int(blob["y_train"].max()) + 1))
 
     def as_x(a):

@@ -94,6 +94,18 @@ class BrokerArtifactStore:
 
     _INDEX_TOPIC = "artifacts/_names"
 
+    # the name-index read-modify-write lock is PER (broker_id, run_id), not
+    # per store instance: publisher and fetcher construct stores
+    # independently (docstring above), and two same-process publishers with
+    # separate instances would otherwise interleave _names()/_write_names()
+    # and lose index entries (round-4 advisor). Keyed by the logical broker
+    # NAME (the same rendezvous get_cas_broker uses) — stable across
+    # release/re-create cycles and bounded by the number of logical
+    # brokers×runs, unlike object ids. Cross-process publishers rendezvous
+    # on the broker itself, which is in-process here.
+    _locks: dict = {}
+    _locks_guard = threading.Lock()
+
     def __init__(self, broker_id: str = "default", run_id: str = "default",
                  keep_rounds: Optional[int] = None):
         from ..comm.broker import get_cas_broker
@@ -101,7 +113,9 @@ class BrokerArtifactStore:
         self.broker = get_cas_broker(broker_id)
         self.run_id = run_id
         self.keep_rounds = keep_rounds
-        self._lock = threading.Lock()
+        with BrokerArtifactStore._locks_guard:
+            self._lock = BrokerArtifactStore._locks.setdefault(
+                (broker_id, run_id), threading.Lock())
 
     def _topic(self, name: str) -> str:
         return f"{self.run_id}/artifacts/{name}"

@@ -85,3 +85,22 @@ def test_flat_override_keys_route_to_owning_section(tmp_path):
     assert cfg.data_args.data_cache_dir == "/silo1/data"
     assert cfg.model_args.model == "cnn"
     assert cfg.train_args.batch_size == 4
+
+
+def test_flat_key_routing_train_args_wins_collisions():
+    """Pin the _FLAT_KEY_SECTION precedence mechanism: sections are written
+    in order and train_args LAST, so every train_args field name routes to
+    train_args even when another section declares the same field (round-4
+    advisor: the old comment claimed first-wins; a reorder would silently
+    re-route flat keys — this test makes that loud)."""
+    import dataclasses
+
+    from fedml_tpu.config import _FLAT_KEY_SECTION, Config
+
+    train_fields = {
+        f.name for f in dataclasses.fields(Config.SECTION_TYPES["train_args"])
+        if f.name != "extra"}
+    assert train_fields, "train_args lost its fields?"
+    for name in train_fields:
+        assert _FLAT_KEY_SECTION[name] == "train_args", (
+            name, _FLAT_KEY_SECTION[name])

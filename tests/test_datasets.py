@@ -290,3 +290,38 @@ def test_tabular_holdout_only_class_widens_head(tmp_path):
     cfg = _cfg("SUSY", tmp_path, client_num_in_total=2, client_num_per_round=2)
     ds = dl.load(cfg)
     assert ds.num_classes == 3
+
+
+def test_token_npz_cache_version_rejects_preshift(tmp_path):
+    """Round-4 advisor: a shakespeare.npz exported BEFORE the +1 vocab
+    shift (id 0 became a reserved pad excluded from NWP loss) must be
+    rejected loudly, not silently reinterpreted; a correctly-versioned
+    cache loads."""
+    rs = np.random.RandomState(0)
+    x = rs.randint(1, 81, (60, 80)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    base = dict(x_train=x, y_train=y, x_test=x[:8], y_test=y[:8])
+
+    # unversioned (pre-shift) cache -> loud rejection naming the fix
+    np.savez(tmp_path / "shakespeare.npz", **base)
+    with pytest.raises(ValueError, match="vocab version None.*expects 2"):
+        dl.load(_cfg("shakespeare", tmp_path))
+
+    # stale version -> same rejection
+    np.savez(tmp_path / "shakespeare.npz", **base, vocab_version=1)
+    with pytest.raises(ValueError, match="vocab version 1"):
+        dl.load(_cfg("shakespeare", tmp_path))
+
+    # current version -> loads, and the ids ride through unshifted
+    np.savez(tmp_path / "shakespeare.npz", **base, vocab_version=2)
+    ds = dl.load(_cfg("shakespeare", tmp_path))
+    assert not ds.synthetic
+    assert int(ds.y_train.max()) <= 80
+
+    # image datasets are untouched by the version gate
+    np.savez(tmp_path / "cifar10.npz",
+             x_train=rs.randint(0, 255, (40, 32, 32, 3), np.uint8),
+             y_train=rs.randint(0, 10, 40),
+             x_test=rs.randint(0, 255, (8, 32, 32, 3), np.uint8),
+             y_test=rs.randint(0, 10, 8))
+    assert not dl.load(_cfg("cifar10", tmp_path)).synthetic
