@@ -53,6 +53,13 @@ DATASET_SHAPES = {
     "room_occupancy": ((5,), 2),
     "lending_club": ((90,), 2),
     "nus_wide": ((634,), 5),
+    # segmentation sets (reference: the fedseg runtime trains
+    # pascal_voc/coco/cityscapes — simulation/mpi/fedseg + data/coco,
+    # data/cityscapes). Class counts match the reference tasks; synthetic
+    # fallback emits dense [H, W] masks at a downscaled resolution.
+    "pascal_voc": ((32, 32, 3), 21),
+    "cityscapes": ((32, 32, 3), 19),
+    "coco_seg": ((32, 32, 3), 81),
 }
 
 # datasets served by the folder-image / landmarks-CSV / tabular-CSV format
@@ -64,6 +71,10 @@ _TABULAR = {"SUSY", "room_occupancy", "lending_club", "nus_wide"}
 # token-sequence NWP tasks: synthetic fallback generates [N, T] int x with
 # per-position next-token targets instead of Gaussian feature vectors
 _TOKEN_TASKS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
+
+# dense-prediction tasks: synthetic fallback generates [N, H, W] label
+# masks (one class-colored square per image) instead of scalar labels
+_SEG_TASKS = {"pascal_voc", "cityscapes", "coco_seg"}
 
 
 def synthetic_classification(
@@ -86,11 +97,14 @@ def synthetic_classification(
     return (x[n_test:], y[n_test:]), (x[:n_test], y[:n_test])
 
 
-def _build_from_arrays(x, y, x_test, y_test, num_classes, cfg: Config) -> FedDataset:
+def _build_from_arrays(x, y, x_test, y_test, num_classes, cfg: Config,
+                       part_labels=None) -> FedDataset:
     t, d = cfg.train_args, cfg.data_args
-    # sequence targets ([N, T] token tasks) partition by their last token;
-    # the Dirichlet partitioner needs one class label per sample
-    part_labels = y if np.ndim(y) == 1 else np.asarray(y)[:, -1]
+    # the Dirichlet partitioner needs ONE class label per sample: sequence
+    # targets ([N, T] token tasks) partition by their last token; dense
+    # targets ([N, H, W] seg masks) must pass part_labels explicitly
+    if part_labels is None:
+        part_labels = y if np.ndim(y) == 1 else np.asarray(y)[:, -1]
     parts = partition(
         part_labels, t.client_num_in_total, d.partition_method,
         d.partition_alpha, seed=cfg.common_args.random_seed,
@@ -125,6 +139,30 @@ def _synthetic_for(name: str, cfg: Config) -> FedDataset:
             x[n_test:].astype(np.int64), y[n_test:].astype(np.int64),
             x[:n_test].astype(np.int64), y[:n_test].astype(np.int64),
             num_classes, cfg)
+        ds.synthetic = True
+        return ds
+    if name in _SEG_TASKS:
+        # dense-prediction task: one class-colored square per image — the
+        # square's class is recoverable from its brightness, so mIoU/pixel
+        # accuracy climbing is a real convergence signal. Class 0 is
+        # background; the per-sample partition label is the square's class.
+        rng = np.random.RandomState(cfg.common_args.random_seed)
+        total = int(n * 1.25)
+        H, W, C = shape
+        x = 0.1 * rng.randn(total, H, W, C).astype(np.float32)
+        y = np.zeros((total, H, W), np.int64)
+        cls = rng.randint(1, num_classes, size=total)
+        h0 = rng.randint(1, H // 2, size=total)
+        w0 = rng.randint(1, W // 2, size=total)
+        sz = rng.randint(H // 4, H // 2, size=total)
+        for i in range(total):
+            hs, ws = slice(h0[i], h0[i] + sz[i]), slice(w0[i], w0[i] + sz[i])
+            x[i, hs, ws, :] += 0.5 + 1.5 * cls[i] / num_classes
+            y[i, hs, ws] = cls[i]
+        n_test = int(total * 0.2)
+        ds = _build_from_arrays(
+            x[n_test:], y[n_test:], x[:n_test], y[:n_test], num_classes,
+            cfg, part_labels=cls[n_test:])
         ds.synthetic = True
         return ds
     (x, y), (xt, yt) = synthetic_classification(
