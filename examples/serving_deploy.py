@@ -60,3 +60,26 @@ finally:
     runner.stop()
     mlops.set_artifact_store(None)
 print("served round-1 artifact over HTTP")
+
+# --- framework-neutral export (the ONNX/Triton-repo analog): write the
+# trained model as manifest.json + tensors.npz, then boot a replica from
+# the export alone — the manifest carries the model recipe
+from fedml_tpu.serving import export_model
+from fedml_tpu.serving.scheduler import start_replica
+
+exp_dir = os.path.join(tempfile.mkdtemp(), "export")
+export_model(exp_dir, sim.server_state.params, model_name="mlp",
+             num_classes=sim.num_classes, input_shape=(64,))
+print("exported:", sorted(os.listdir(exp_dir)))
+_rid, runner2 = start_replica({"export_dir": exp_dir, "port": 0})
+try:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{runner2.port}/predict",
+        data=json.dumps({"inputs": x.tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    out2 = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    print("served from export:", out2["predictions"])
+    assert len(out2["predictions"]) == len(x)
+finally:
+    runner2.stop()
+print("OK serving deploy (artifact + export paths)")

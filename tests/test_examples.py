@@ -21,6 +21,7 @@ _CASES = [
     ("fedllm_lora.py", ["--ring"]),
     ("fedllm_lora.py", ["--int8"]),
     ("serving_deploy.py", []),
+    ("federated_segmentation.py", []),
     ("attack_vs_defense.py", []),
     ("federated_analytics.py", []),
     ("platform_api.py", []),
