@@ -142,6 +142,70 @@ def synth_quantized_base(rng: jax.Array, shapes: Pytree) -> Pytree:
                   for i, (path, sd) in enumerate(leaves)])
 
 
+# ---- shared functional-forward helpers: the LLaMA block math used by BOTH
+# the in-scan training forward below and the KV-cache serving decode
+# (llm/decode.py). One implementation, so dequant/LoRA-merge semantics
+# cannot drift between training and serving.
+def rms_norm(x, scale, eps: float = 1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def split_adapters(adapters, alpha: float):
+    """(stacked per-block adapter slices, top-level adapters, rank_scale);
+    None/empty adapters -> ({}, {}, 0.0)."""
+    if not adapters:
+        return {}, {}, 0.0
+    rank = next(iter(adapters.values()))["a"].shape[-1]
+    blk = {k[len("blocks/"):]: v for k, v in adapters.items()
+           if k.startswith("blocks/")}
+    top = {k: v for k, v in adapters.items()
+           if not k.startswith("blocks/")}
+    return blk, top, alpha / rank
+
+
+def merged_kernel(block, ad_l, name, rank_scale, dtype=jnp.bfloat16):
+    """Dequantized (or passthrough) kernel with its LoRA delta merged."""
+    w = dequant_leaf(block[name]["kernel"], dtype)
+    a = ad_l.get(f"{name}/kernel") if ad_l else None
+    if a is not None:
+        w = w + rank_scale * (a["a"] @ a["b"]).astype(w.dtype)
+    return w
+
+
+def project_qkv(block, ad_l, rank_scale, h, n_heads: int,
+                dtype=jnp.bfloat16):
+    """Pre-norm hidden -> per-head q/k/v [B, T, H, Dh] (RoPE is applied by
+    the caller, whose position semantics differ between train and decode)."""
+    d_model = h.shape[-1]
+    dh = d_model // n_heads
+    q = h @ merged_kernel(block, ad_l, "wq", rank_scale, dtype)
+    k = h @ merged_kernel(block, ad_l, "wk", rank_scale, dtype)
+    v = h @ merged_kernel(block, ad_l, "wv", rank_scale, dtype)
+    split = lambda a: a.reshape(a.shape[:2] + (n_heads, dh))
+    return split(q), split(k), split(v)
+
+
+def swiglu_mlp(block, ad_l, rank_scale, x, dtype=jnp.bfloat16,
+               eps: float = 1e-6):
+    h = rms_norm(x, dequant_leaf(block["RMSNorm_1"]["scale"], dtype), eps)
+    gate = h @ merged_kernel(block, ad_l, "w_gate", rank_scale, dtype)
+    up = h @ merged_kernel(block, ad_l, "w_up", rank_scale, dtype)
+    return x + (jax.nn.silu(gate) * up) @ merged_kernel(
+        block, ad_l, "w_down", rank_scale, dtype)
+
+
+def lm_head_logits(params, top_ads, rank_scale, x, dtype=jnp.bfloat16,
+                   eps: float = 1e-6):
+    x = rms_norm(x, dequant_leaf(params["final_norm"]["scale"], dtype), eps)
+    head = dequant_leaf(params["lm_head"]["kernel"], dtype)
+    a = top_ads.get("lm_head/kernel") if top_ads else None
+    if a is not None:
+        head = head + rank_scale * (a["a"] @ a["b"]).astype(head.dtype)
+    return x @ head
+
+
 def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
                             remat: bool = True, dtype=jnp.bfloat16,
                             eps: float = 1e-6):
@@ -181,51 +245,22 @@ def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
 
     attn = attn_fn or dense_causal_attention
 
-    def norm(x, scale):
-        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
-                       keepdims=True)
-        return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
-
-    def dq(leaf):
-        return dequant_leaf(leaf, dtype)
-
-    def merged(bl, ad_l, name, rank_scale):
-        w = dq(bl[name]["kernel"])
-        a = ad_l.get(f"{name}/kernel")
-        if a is not None:
-            w = w + rank_scale * (a["a"] @ a["b"]).astype(w.dtype)
-        return w
-
     def apply(qparams, adapters, tokens, pos_offset=0):
-        rank = next(iter(adapters.values()))["a"].shape[-1]
-        rank_scale = alpha / rank
-        # split adapters into stacked per-block slices vs top-level ones
-        blk_ads = {k[len("blocks/"):]: v for k, v in adapters.items()
-                   if k.startswith("blocks/")}
-        top_ads = {k: v for k, v in adapters.items()
-                   if not k.startswith("blocks/")}
-        emb = dq(qparams["embed"]["embedding"])
+        blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
+        emb = dequant_leaf(qparams["embed"]["embedding"], dtype)
         x = emb[tokens]
         pos = pos_offset + jnp.arange(tokens.shape[1])
 
         def body(x, layer):
             bl, ad_l = layer
             d_model = x.shape[-1]
-            dh = d_model // n_heads
-            h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
-            q = h @ merged(bl, ad_l, "wq", rank_scale)
-            k = h @ merged(bl, ad_l, "wk", rank_scale)
-            v = h @ merged(bl, ad_l, "wv", rank_scale)
-            split = lambda a: a.reshape(a.shape[:2] + (n_heads, dh))
-            q, k, v = split(q), split(k), split(v)
+            h = rms_norm(x, dequant_leaf(bl["RMSNorm_0"]["scale"], dtype),
+                         eps)
+            q, k, v = project_qkv(bl, ad_l, rank_scale, h, n_heads, dtype)
             q, k = rope(q, pos), rope(k, pos)
             o = attn(q, k, v).reshape(x.shape[:2] + (d_model,))
-            x = x + o @ merged(bl, ad_l, "wo", rank_scale)
-            h = norm(x, dq(bl["RMSNorm_1"]["scale"]))
-            gate = h @ merged(bl, ad_l, "w_gate", rank_scale)
-            up = h @ merged(bl, ad_l, "w_up", rank_scale)
-            x = x + (jax.nn.silu(gate) * up) @ merged(
-                bl, ad_l, "w_down", rank_scale)
+            x = x + o @ merged_kernel(bl, ad_l, "wo", rank_scale, dtype)
+            x = swiglu_mlp(bl, ad_l, rank_scale, x, dtype, eps)
             return x, None
 
         if remat:
@@ -235,12 +270,7 @@ def make_inscan_quant_apply(n_heads: int, attn_fn=None, alpha: float = 16.0,
             # pattern this function mirrors)
             body = jax.checkpoint(body, prevent_cse=False)
         x, _ = jax.lax.scan(body, x, (qparams["blocks"], blk_ads))
-        x = norm(x, dq(qparams["final_norm"]["scale"]))
-        head = dq(qparams["lm_head"]["kernel"])
-        a = top_ads.get("lm_head/kernel")
-        if a is not None:
-            head = head + rank_scale * (a["a"] @ a["b"]).astype(head.dtype)
-        return x @ head
+        return lm_head_logits(qparams, top_ads, rank_scale, x, dtype, eps)
 
     return apply
 
