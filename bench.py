@@ -732,6 +732,17 @@ def bench_fedllm_7b() -> dict:
                   f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
     if not out:
         out = {"fedllm_ceiling_error": "no ladder config fit/ran"}
+    elif "fedllm_ceiling_params" in out:
+        # LONG-CONTEXT probe, only after the main rung ran: the same full
+        # 7B shape at T=8192 — workload 5's long-sequence axis on one chip
+        # (flash attention + remat + in-scan int8 keep it inside 16 GB;
+        # measured when added: 2,601 tok/s at 0.539 MFU)
+        try:
+            out.update(rung("7b_int8_T8192", 4096, 32, 32, 11008, 1, 8192,
+                            prefix="fedllm_longctx"))
+        except Exception as e:  # noqa: BLE001
+            out["fedllm_longctx_error"] = \
+                f"{type(e).__name__}: {clean(str(e))}"
     if skipped:
         # every rung that did NOT run, with why — a 7B attempt that died in
         # this environment's remote-compile helper is evidence of the
@@ -817,6 +828,7 @@ _HEADLINE_KEYS = (
     "fedllm_1b_params",
     "fedllm_ceiling_params", "fedllm_ceiling_tokens_per_sec",
     "fedllm_ceiling_mfu_vs_spec_peak",
+    "fedllm_longctx_tokens_per_sec", "fedllm_longctx_mfu_vs_spec_peak",
     "flash_attn_speedup_vs_xla_dense",
     "data_synthetic", "spec_peak_tflops_bf16",
     "matmul_peak_tflops_measured", "fedllm_round_tokens_per_sec",
