@@ -49,8 +49,9 @@ def stack_blocks(params: Pytree, n_layers: int) -> Pytree:
     path consumes. Scan-layout trees pass through unchanged."""
     if "blocks" in params:
         return params
-    blocks = [params[f"block_{i}"] for i in range(n_layers)]
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    from ..ops.tree import tree_stack
+
+    stacked = tree_stack([params[f"block_{i}"] for i in range(n_layers)])
     out = {k: v for k, v in params.items() if not k.startswith("block_")}
     out["blocks"] = stacked
     return out
@@ -182,10 +183,15 @@ def make_greedy_generate(n_heads: int, alpha: float = 16.0,
         def one(carry, i):
             cache, tok = carry
             cache, logits = step(params, adapters, cache, pos0 + i, tok)
-            return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), tok
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (cache, nxt), nxt
 
-        (_cache, _tok), toks = jax.lax.scan(
-            one, (cache, first), jnp.arange(n_steps))
+        # n_steps - 1 decode steps: token 1 comes from prefill, and the
+        # last emitted token needs no further step (scanning n_steps would
+        # pay one full per-layer pass whose result is discarded)
+        (_cache, _tok), rest = jax.lax.scan(
+            one, (cache, first), jnp.arange(n_steps - 1))
+        toks = jnp.concatenate([first[None], rest], axis=0)
         return toks[:, 0]                                    # batch-1
 
     return generate

@@ -98,9 +98,10 @@ class GreedyLMPredictor:
 
     kv_cache=True (default-dense-attention models only) replaces the
     per-step full-buffer recompute with the KV-cached functional decode
-    (llm/decode.py): O(D² + T·D) per token instead of O(T·D²), same
-    tokens. Prompts are bucketed and the real length rides traced, so the
-    compile cache stays bounded on both paths."""
+    (llm/decode.py): O(D² + T·D) per token instead of O(T·D²), computed
+    in the params' own dtype so numerics match the recompute path (same
+    tokens; parity-pinned). Prompts are bucketed and the real length
+    rides traced, so the compile cache stays bounded on both paths."""
 
     def __init__(self, model, params: Pytree,
                  detokenize: Optional[Callable[[list[int]], str]] = None,
@@ -123,13 +124,18 @@ class GreedyLMPredictor:
                     "functional decode body)")
             from ..llm.decode import make_greedy_generate, stack_blocks
 
-            stacked = stack_blocks(params, model.n_layers)
             # the kv path never touches the unrolled tree again — keep ONE
             # copy resident (stack_blocks materializes a full stacked copy
-            # for unrolled inputs; holding both would double parameter HBM)
-            self.params = stacked
-            params = stacked
-            kv_gen = make_greedy_generate(model.n_heads)
+            # for unrolled inputs; holding both would double parameter
+            # HBM), and self.params IS the tree the kv path serves
+            self.params = stack_blocks(params, model.n_layers)
+            # decode in the params' own compute dtype, so kv and recompute
+            # paths see the same numerics (float params stay float32; a
+            # bf16-cast tree decodes in bf16, matching model.apply)
+            float_leaves = [l for l in jax.tree.leaves(self.params)
+                            if jnp.issubdtype(l.dtype, jnp.floating)]
+            kv_dtype = float_leaves[0].dtype if float_leaves else jnp.float32
+            kv_gen = make_greedy_generate(model.n_heads, dtype=kv_dtype)
 
             # prompts are right-padded to a power-of-two bucket and the
             # real length rides as a traced arg, so compiled programs are
@@ -140,8 +146,8 @@ class GreedyLMPredictor:
                 return kv_gen(params, None, tokens, max_len, n_steps,
                               length=length)
 
-            self._params_stacked = stacked
             self._generate_kv = generate_kv
+            return
 
         # n_steps is a Python int at trace time (scan length must be
         # static) -> one compiled program per power-of-two bucket
@@ -185,7 +191,7 @@ class GreedyLMPredictor:
             prompt = np.zeros((1, pbucket), np.int32)
             prompt[0, : len(toks)] = toks
             out_toks = self._generate_kv(
-                self._params_stacked, jnp.asarray(prompt),
+                self.params, jnp.asarray(prompt),
                 jnp.int32(len(toks)), int(self.max_len), int(steps))
         else:
             buf = np.zeros((1, self.max_len), np.int32)

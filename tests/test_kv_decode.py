@@ -139,3 +139,31 @@ def test_predictor_kv_cache_matches_recompute_path():
                        d_ff=FF, attn_fn=dense_causal_attention)
     with pytest.raises(ValueError, match="dense attention only"):
         GreedyLMPredictor(m2, params, max_len=MAXLEN, kv_cache=True)
+
+
+def test_predictor_kv_cache_bf16_params_match_recompute():
+    """bf16-served params: the kv path decodes in the params' own dtype,
+    so its tokens match the recompute path's (both compute bf16)."""
+    from fedml_tpu.serving.predictor import GreedyLMPredictor
+
+    model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                          d_ff=FF)
+    p32 = model.init(jax.random.key(3),
+                     jnp.zeros((1, TP), jnp.int32))["params"]
+    p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p32)
+    prompt = np.random.RandomState(4).randint(1, V, TP).tolist()
+    req = {"tokens": prompt, "max_new_tokens": 6}
+    slow = GreedyLMPredictor(model, p16, max_len=MAXLEN)
+    fast = GreedyLMPredictor(model, p16, max_len=MAXLEN, kv_cache=True)
+    assert fast.predict(req)["generated_tokens"] == \
+        slow.predict(req)["generated_tokens"]
+
+
+def test_generate_single_token_costs_prefill_only():
+    """max_new_tokens=1: the first token comes from prefill; the scan runs
+    zero decode steps (a trailing wasted step was review-flagged)."""
+    _model, params, ads, ref_apply, ref_ads, toks = _setup(False, False)
+    gen = make_greedy_generate(H)
+    got = jax.jit(gen, static_argnums=(3, 4))(params, ads, toks, MAXLEN, 1)
+    want = _ref_greedy(ref_apply, params, ref_ads, toks, 1)
+    assert np.asarray(got).tolist() == want
