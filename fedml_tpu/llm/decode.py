@@ -57,6 +57,33 @@ def stack_blocks(params: Pytree, n_layers: int) -> Pytree:
     return out
 
 
+def stack_adapter_blocks(adapters: Optional[Pytree],
+                         n_layers: int) -> Optional[Pytree]:
+    """Convert UNROLLED-layout LoRA adapter keys (block_0/wq/kernel ...)
+    to the stacked form (blocks/wq/kernel with a leading [L] axis) that
+    split_adapters consumes. Stacked/None/top-level-only trees pass
+    through. Without this, unrolled adapter keys would miss the 'blocks/'
+    prefix and be SILENTLY ignored by the decode path."""
+    if not adapters or not any(k.startswith("block_0/") for k in adapters):
+        return adapters
+    from ..ops.tree import tree_stack
+
+    out = {k: v for k, v in adapters.items()
+           if not (k.startswith("block_") and k.split("/", 1)[0][6:].isdigit())}
+    suffixes = sorted(k.split("/", 1)[1] for k in adapters
+                      if k.startswith("block_0/"))
+    for suf in suffixes:
+        try:
+            parts = [adapters[f"block_{i}/{suf}"] for i in range(n_layers)]
+        except KeyError as e:
+            raise ValueError(
+                f"adapter tree adapts {suf!r} on some layers but not "
+                f"{e.args[0]!r} — per-layer-uniform adapters are required "
+                "to stack into the scan layout") from None
+        out[f"blocks/{suf}"] = tree_stack(parts)
+    return out
+
+
 def make_kv_decode(n_heads: int, alpha: float = 16.0,
                    dtype=jnp.float32, eps: float = 1e-6):
     """Returns (prefill, step) over scan-layout params (float or int8

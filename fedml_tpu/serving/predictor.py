@@ -105,13 +105,30 @@ class GreedyLMPredictor:
 
     def __init__(self, model, params: Pytree,
                  detokenize: Optional[Callable[[list[int]], str]] = None,
-                 max_len: int = 256, kv_cache: bool = False):
+                 max_len: int = 256, kv_cache: bool = False,
+                 adapters: Optional[Pytree] = None,
+                 compute_dtype: Optional[str] = None):
         self.model = model
         self.params = params
         self.detokenize = detokenize
         self.max_len = max_len
         self.kv_cache = kv_cache
+        self.adapters = adapters
 
+        if adapters is not None and not kv_cache:
+            # the recompute path drives model.apply, which knows nothing of
+            # adapter trees or int8 {q,s} leaves; the kv decode handles both
+            raise ValueError(
+                "adapters (the QLoRA serving layout: frozen base + LoRA) "
+                "need kv_cache=True — the functional decode merges them "
+                "per layer; or pre-merge with llm.lora.lora_merge and pass "
+                "plain params")
+        if compute_dtype is not None and not kv_cache:
+            raise ValueError(
+                "compute_dtype only applies to kv_cache=True (the "
+                "recompute path runs model.apply in the params' own "
+                "dtype); cast the params instead, e.g. "
+                "jax.tree.map(lambda a: a.astype(dtype), params)")
         if kv_cache:
             # O(D² + T·D) per token via llm/decode.py instead of a full
             # O(T·D²) recompute — parity-pinned in tests/test_kv_decode.py.
@@ -122,8 +139,14 @@ class GreedyLMPredictor:
                     "kv_cache=True supports the default dense attention "
                     "only (custom attn_fn is not replicated by the "
                     "functional decode body)")
-            from ..llm.decode import make_greedy_generate, stack_blocks
+            from ..llm.decode import (
+                make_greedy_generate, stack_adapter_blocks, stack_blocks,
+            )
 
+            # unrolled-layout adapters restack alongside the params —
+            # block_i/... keys would otherwise be silently ignored by
+            # split_adapters' blocks/ routing
+            self.adapters = stack_adapter_blocks(adapters, model.n_layers)
             # the kv path never touches the unrolled tree again — keep ONE
             # copy resident (stack_blocks materializes a full stacked copy
             # for unrolled inputs; holding both would double parameter
@@ -131,19 +154,26 @@ class GreedyLMPredictor:
             self.params = stack_blocks(params, model.n_layers)
             # decode in the params' own compute dtype, so kv and recompute
             # paths see the same numerics (float params stay float32; a
-            # bf16-cast tree decodes in bf16, matching model.apply)
-            float_leaves = [l for l in jax.tree.leaves(self.params)
-                            if jnp.issubdtype(l.dtype, jnp.floating)]
-            kv_dtype = float_leaves[0].dtype if float_leaves else jnp.float32
+            # bf16-cast tree decodes in bf16, matching model.apply).
+            # compute_dtype overrides — e.g. "bfloat16" for an int8 base
+            # whose float leaves are the f32 scales
+            if compute_dtype is not None:
+                kv_dtype = jnp.dtype(compute_dtype)
+            else:
+                float_leaves = [l for l in jax.tree.leaves(self.params)
+                                if jnp.issubdtype(l.dtype, jnp.floating)]
+                kv_dtype = (float_leaves[0].dtype if float_leaves
+                            else jnp.float32)
             kv_gen = make_greedy_generate(model.n_heads, dtype=kv_dtype)
 
             # prompts are right-padded to a power-of-two bucket and the
             # real length rides as a traced arg, so compiled programs are
             # keyed by (prompt bucket, step bucket) — bounded, like the
             # recompute path's fixed buffer
-            @functools.partial(jax.jit, static_argnums=(3, 4))
-            def generate_kv(params, tokens, length, max_len, n_steps):
-                return kv_gen(params, None, tokens, max_len, n_steps,
+            @functools.partial(jax.jit, static_argnums=(4, 5))
+            def generate_kv(params, adapters, tokens, length, max_len,
+                            n_steps):
+                return kv_gen(params, adapters, tokens, max_len, n_steps,
                               length=length)
 
             self._generate_kv = generate_kv
@@ -191,7 +221,7 @@ class GreedyLMPredictor:
             prompt = np.zeros((1, pbucket), np.int32)
             prompt[0, : len(toks)] = toks
             out_toks = self._generate_kv(
-                self.params, jnp.asarray(prompt),
+                self.params, self.adapters, jnp.asarray(prompt),
                 jnp.int32(len(toks)), int(self.max_len), int(steps))
         else:
             buf = np.zeros((1, self.max_len), np.int32)
