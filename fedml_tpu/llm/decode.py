@@ -188,29 +188,48 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
     return prefill, step
 
 
-def make_greedy_generate(n_heads: int, alpha: float = 16.0,
-                         dtype=jnp.float32, eps: float = 1e-6):
-    """generate(params, adapters, tokens, max_len, n_steps) -> [n_steps]
-    greedy tokens for batch-1 prompts — prefill once, then a lax.scan of
-    KV-cached steps, all inside the caller's jit (n_steps/max_len static)."""
+def make_generate(n_heads: int, alpha: float = 16.0,
+                  dtype=jnp.float32, eps: float = 1e-6,
+                  sample: bool = False, top_k: int = 0):
+    """generate(params, adapters, tokens, max_len, n_steps, length=None,
+    rng=None, temperature=1.0) -> [n_steps] tokens for batch-1 prompts —
+    prefill once, then a lax.scan of KV-cached steps, all inside the
+    caller's jit (n_steps/max_len static).
+
+    sample=False (default) is greedy argmax. sample=True draws from
+    softmax(logits / temperature) with an optional static top_k cutoff
+    (the HF generate() sampling knobs the reference's serving inherits);
+    temperature is TRACED, so one compiled program covers every
+    temperature, while top_k and sample are compile-time."""
     prefill, step = make_kv_decode(n_heads, alpha=alpha, dtype=dtype,
                                    eps=eps)
 
+    def pick(logits, key, temperature):
+        if not sample:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        l = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        if top_k:
+            kth = jax.lax.top_k(l, top_k)[0][..., -1:]
+            l = jnp.where(l < kth, -jnp.inf, l)
+        return jax.random.categorical(key, l, -1).astype(jnp.int32)
+
     def generate(params, adapters, tokens, max_len: int, n_steps: int,
-                 length=None):
+                 length=None, rng=None, temperature=1.0):
         """tokens may be right-padded to a bucket with `length` the real
         prompt length (traced ok) — the predictor uses this so compiled
         programs are keyed by (prompt bucket, step bucket), not by every
         distinct prompt length."""
+        if rng is None:
+            rng = jax.random.key(0)
         cache, logits = prefill(params, adapters, tokens, max_len,
                                 length=length)
-        first = jnp.argmax(logits, -1).astype(jnp.int32)     # [B]
+        first = pick(logits, jax.random.fold_in(rng, 0), temperature)
         pos0 = tokens.shape[1] if length is None else length
 
         def one(carry, i):
             cache, tok = carry
             cache, logits = step(params, adapters, cache, pos0 + i, tok)
-            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            nxt = pick(logits, jax.random.fold_in(rng, i + 1), temperature)
             return (cache, nxt), nxt
 
         # n_steps - 1 decode steps: token 1 comes from prefill, and the
@@ -222,3 +241,11 @@ def make_greedy_generate(n_heads: int, alpha: float = 16.0,
         return toks[:, 0]                                    # batch-1
 
     return generate
+
+
+def make_greedy_generate(n_heads: int, alpha: float = 16.0,
+                         dtype=jnp.float32, eps: float = 1e-6):
+    """Greedy specialization of make_generate (kept as the stable name the
+    predictor and tests use)."""
+    return make_generate(n_heads, alpha=alpha, dtype=dtype, eps=eps,
+                         sample=False)

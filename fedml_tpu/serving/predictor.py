@@ -177,6 +177,8 @@ class GreedyLMPredictor:
                               length=length)
 
             self._generate_kv = generate_kv
+            self._kv_dtype = kv_dtype
+            self._samplers: dict = {}   # top_k -> jitted sampling generate
             return
 
         # n_steps is a Python int at trace time (scan length must be
@@ -215,14 +217,62 @@ class GreedyLMPredictor:
                 f"{steps} decode steps) exceeds max_len {self.max_len}; "
                 "shorten the prompt, lower max_new_tokens, or raise "
                 "max_len")
+        temperature = float(input_json.get("temperature", 0.0))
+        knobs = [k for k in ("top_k", "seed") if k in input_json]
+        if (temperature > 0 or knobs) and not self.kv_cache:
+            raise ValueError(
+                "sampling (temperature/top_k/seed) needs kv_cache=True; "
+                "the recompute path is greedy-only")
+        if temperature <= 0 and knobs:
+            raise ValueError(
+                f"{'/'.join(knobs)} only apply when temperature > 0 "
+                "(temperature omitted or 0 means greedy decoding — the "
+                "knobs would be silently ignored)")
         if self.kv_cache:
             pbucket = min(_bucket(len(toks), pow2_cap=self.max_len),
                           self.max_len)
             prompt = np.zeros((1, pbucket), np.int32)
             prompt[0, : len(toks)] = toks
-            out_toks = self._generate_kv(
-                self.params, self.adapters, jnp.asarray(prompt),
-                jnp.int32(len(toks)), int(self.max_len), int(steps))
+            if temperature > 0:
+                # sampling: softmax(logits/T) with optional static top-k —
+                # T and the seed ride traced (the HF generate() knobs the
+                # reference's serving surface inherits). top_k is a
+                # compile-time shape knob, so it is VALIDATED and rounded
+                # up to a power of two: the compile cache stays bounded at
+                # log2(vocab) programs instead of one per raw client value
+                top_k = int(input_json.get("top_k", 0))
+                vocab = int(self.model.vocab_size)
+                if top_k < 0 or top_k > vocab:
+                    raise ValueError(
+                        f"top_k must be in [0, vocab_size={vocab}]; got "
+                        f"{top_k} (0 disables the cutoff)")
+                if top_k:
+                    top_k = min(_bucket(top_k, pow2_cap=vocab), vocab)
+                gen = self._samplers.get(top_k)
+                if gen is None:
+                    from ..llm.decode import make_generate
+
+                    kv_gen = make_generate(self.model.n_heads,
+                                           dtype=self._kv_dtype,
+                                           sample=True, top_k=top_k)
+
+                    @functools.partial(jax.jit, static_argnums=(4, 5))
+                    def gen(params, adapters, tokens, length, max_len,
+                            n_steps, rng, temp):
+                        return kv_gen(params, adapters, tokens, max_len,
+                                      n_steps, length=length, rng=rng,
+                                      temperature=temp)
+
+                    self._samplers[top_k] = gen
+                out_toks = gen(
+                    self.params, self.adapters, jnp.asarray(prompt),
+                    jnp.int32(len(toks)), int(self.max_len), int(steps),
+                    jax.random.key(int(input_json.get("seed", 0))),
+                    jnp.float32(temperature))
+            else:
+                out_toks = self._generate_kv(
+                    self.params, self.adapters, jnp.asarray(prompt),
+                    jnp.int32(len(toks)), int(self.max_len), int(steps))
         else:
             buf = np.zeros((1, self.max_len), np.int32)
             buf[0, : len(toks)] = toks

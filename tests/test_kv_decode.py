@@ -225,3 +225,80 @@ def test_predictor_compute_dtype_needs_kv_cache():
     with pytest.raises(ValueError, match="compute_dtype only applies"):
         GreedyLMPredictor(model, p, max_len=MAXLEN,
                           compute_dtype="bfloat16")
+
+
+def test_sampling_decode_temperature_and_topk():
+    """Sampling knobs (llm/decode.py make_generate): top_k=1 reduces to
+    greedy regardless of temperature; same seed is deterministic; near-zero
+    temperature matches greedy; different seeds at high temperature
+    diverge; sampling without kv_cache refuses."""
+    from fedml_tpu.llm.decode import make_generate
+    from fedml_tpu.serving.predictor import GreedyLMPredictor
+
+    model, params, ads, ref_apply, ref_ads, toks = _setup(False, False)
+    greedy = jax.jit(make_greedy_generate(H), static_argnums=(3, 4))(
+        params, ads, toks, MAXLEN, 8)
+
+    top1 = make_generate(H, sample=True, top_k=1)
+    got = jax.jit(top1, static_argnums=(3, 4))(
+        params, ads, toks, MAXLEN, 8, rng=jax.random.key(7),
+        temperature=jnp.float32(5.0))
+    assert np.asarray(got).tolist() == np.asarray(greedy).tolist()
+
+    samp = jax.jit(make_generate(H, sample=True), static_argnums=(3, 4))
+    cold = samp(params, ads, toks, MAXLEN, 8, rng=jax.random.key(1),
+                temperature=jnp.float32(1e-4))
+    assert np.asarray(cold).tolist() == np.asarray(greedy).tolist()
+    a = samp(params, ads, toks, MAXLEN, 8, rng=jax.random.key(2),
+             temperature=jnp.float32(3.0))
+    b = samp(params, ads, toks, MAXLEN, 8, rng=jax.random.key(2),
+             temperature=jnp.float32(3.0))
+    c = samp(params, ads, toks, MAXLEN, 8, rng=jax.random.key(3),
+             temperature=jnp.float32(3.0))
+    assert np.asarray(a).tolist() == np.asarray(b).tolist()   # same seed
+    assert np.asarray(a).tolist() != np.asarray(c).tolist()   # new seed
+
+    # predictor surface: request-level knobs, deterministic per seed
+    m2 = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                       d_ff=FF, scan_layers=True)
+    pred = GreedyLMPredictor(m2, params, max_len=MAXLEN, kv_cache=True,
+                             adapters=ads)
+    prompt = np.asarray(toks)[0].tolist()
+    r1 = pred.predict({"tokens": prompt, "max_new_tokens": 6,
+                       "temperature": 2.0, "seed": 11})
+    r2 = pred.predict({"tokens": prompt, "max_new_tokens": 6,
+                       "temperature": 2.0, "seed": 11})
+    assert r1["generated_tokens"] == r2["generated_tokens"]
+    slow = GreedyLMPredictor(m2, params, max_len=MAXLEN)
+    with pytest.raises(ValueError, match="needs kv_cache=True"):
+        slow.predict({"tokens": prompt, "max_new_tokens": 4,
+                      "temperature": 1.0})
+
+
+def test_sampling_knob_validation():
+    """Request knobs fail loudly, never silently: top_k out of range,
+    top_k/seed without temperature, and the top_k compile cache is keyed
+    by power-of-two buckets, not raw client values."""
+    from fedml_tpu.serving.predictor import GreedyLMPredictor
+
+    _m, params, ads, _ra, _rads, toks = _setup(False, False)
+    model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
+                          d_ff=FF, scan_layers=True)
+    pred = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True)
+    prompt = np.asarray(toks)[0].tolist()
+    with pytest.raises(ValueError, match="top_k must be in"):
+        pred.predict({"tokens": prompt, "max_new_tokens": 2,
+                      "temperature": 1.0, "top_k": -1})
+    with pytest.raises(ValueError, match="top_k must be in"):
+        pred.predict({"tokens": prompt, "max_new_tokens": 2,
+                      "temperature": 1.0, "top_k": V + 1})
+    with pytest.raises(ValueError, match="only apply when temperature"):
+        pred.predict({"tokens": prompt, "max_new_tokens": 2, "top_k": 5})
+    with pytest.raises(ValueError, match="only apply when temperature"):
+        pred.predict({"tokens": prompt, "max_new_tokens": 2, "seed": 3})
+    # raw top_k values 5 and 7 share the pow2-bucket-8 program
+    pred.predict({"tokens": prompt, "max_new_tokens": 2,
+                  "temperature": 1.0, "top_k": 5})
+    pred.predict({"tokens": prompt, "max_new_tokens": 2,
+                  "temperature": 1.0, "top_k": 7})
+    assert list(pred._samplers) == [8]
