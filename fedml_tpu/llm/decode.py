@@ -85,7 +85,8 @@ def stack_adapter_blocks(adapters: Optional[Pytree],
 
 
 def make_kv_decode(n_heads: int, alpha: float = 16.0,
-                   dtype=jnp.float32, eps: float = 1e-6):
+                   dtype=jnp.float32, eps: float = 1e-6,
+                   prefill_attn_fn=None):
     """Returns (prefill, step) over scan-layout params (float or int8
     {q, s} leaves; `adapters` is a llm.lora tree or None).
 
@@ -94,8 +95,15 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
                                   # [L, B, max_len, H, Dh]
     step(params, adapters, cache, pos, token)
         -> (cache, logits)        # token [B] at global position `pos`
-    """
+
+    prefill_attn_fn swaps the prompt pass's attention (default dense
+    causal) — pass ops.flash_attention.flash_attn_fn for long prompts,
+    where the O(T²) dense materialization is the prefill bottleneck; the
+    decode steps are unaffected (their attention is a masked [1, T]
+    row against the cache, already O(T))."""
     from .transformer import rope
+
+    prefill_attn = prefill_attn_fn or dense_causal_attention
 
     # block math shared with the in-scan training forward (quant.py) —
     # one implementation, bound to this decode's dtype/eps/alpha
@@ -139,7 +147,7 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
             h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
             q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
             q, k = rope(q, pos), rope(k, pos)
-            o = dense_causal_attention(q, k, v)
+            o = prefill_attn(q, k, v)
             x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
                 bl, ad_l, "wo", rank_scale)
             x = mlp(bl, ad_l, rank_scale, x)
@@ -190,7 +198,8 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
 
 def make_generate(n_heads: int, alpha: float = 16.0,
                   dtype=jnp.float32, eps: float = 1e-6,
-                  sample: bool = False, top_k: int = 0):
+                  sample: bool = False, top_k: int = 0,
+                  prefill_attn_fn=None):
     """generate(params, adapters, tokens, max_len, n_steps, length=None,
     rng=None, temperature=1.0) -> [n_steps] tokens for batch-1 prompts —
     prefill once, then a lax.scan of KV-cached steps, all inside the
@@ -202,7 +211,7 @@ def make_generate(n_heads: int, alpha: float = 16.0,
     temperature is TRACED, so one compiled program covers every
     temperature, while top_k and sample are compile-time."""
     prefill, step = make_kv_decode(n_heads, alpha=alpha, dtype=dtype,
-                                   eps=eps)
+                                   eps=eps, prefill_attn_fn=prefill_attn_fn)
 
     def pick(logits, key, temperature):
         if not sample:

@@ -218,7 +218,11 @@ class GreedyLMPredictor:
                 "shorten the prompt, lower max_new_tokens, or raise "
                 "max_len")
         temperature = float(input_json.get("temperature", 0.0))
-        knobs = [k for k in ("top_k", "seed") if k in input_json]
+        # a knob at its documented disabled default (top_k=0, seed=0) is
+        # equivalent to omitting it — client SDKs that serialize defaults
+        # must not be rejected on greedy requests
+        knobs = [k for k in ("top_k", "seed")
+                 if int(input_json.get(k) or 0) != 0]
         if (temperature > 0 or knobs) and not self.kv_cache:
             raise ValueError(
                 "sampling (temperature/top_k/seed) needs kv_cache=True; "
@@ -264,11 +268,19 @@ class GreedyLMPredictor:
                                       temperature=temp)
 
                     self._samplers[top_k] = gen
+                # no client seed -> a fresh one per request, so repeated
+                # sampling requests VARY (the normal serving contract);
+                # pass "seed" explicitly for reproducible generations
+                if "seed" in input_json:
+                    seed = int(input_json["seed"])
+                else:
+                    import random as _random
+
+                    seed = _random.getrandbits(31)
                 out_toks = gen(
                     self.params, self.adapters, jnp.asarray(prompt),
                     jnp.int32(len(toks)), int(self.max_len), int(steps),
-                    jax.random.key(int(input_json.get("seed", 0))),
-                    jnp.float32(temperature))
+                    jax.random.key(seed), jnp.float32(temperature))
             else:
                 out_toks = self._generate_kv(
                     self.params, self.adapters, jnp.asarray(prompt),
