@@ -93,3 +93,20 @@ for r in range(8):
               ids, weights, jax.random.fold_in(jax.random.key(2), r), None)
     st = out.server_state
     print(f"{label} {r}: loss={float(out.metrics['train_loss']):.3f}")
+
+if "--int8" in sys.argv:
+    # serve the federated result DIRECTLY in its QLoRA layout: int8 frozen
+    # base + the trained adapters, KV-cache decode, greedy then sampled
+    # (serving/predictor.py + llm/decode.py)
+    from fedml_tpu.serving import GreedyLMPredictor
+
+    pred = GreedyLMPredictor(model, qbase, max_len=64, kv_cache=True,
+                             adapters=st.params)
+    prompt = seqs[0, 0, :8].astype(int).tolist()
+    greedy = pred.predict({"tokens": prompt, "max_new_tokens": 8})
+    sampled = pred.predict({"tokens": prompt, "max_new_tokens": 8,
+                            "temperature": 0.8, "top_k": 8, "seed": 0})
+    print("served greedy:", greedy["generated_tokens"])
+    print("served sampled:", sampled["generated_tokens"])
+    assert len(greedy["generated_tokens"]) == 8
+print("OK fedllm lora")

@@ -84,6 +84,22 @@ def stack_adapter_blocks(adapters: Optional[Pytree],
     return out
 
 
+def _rope_rows(x, pos_rows, base: float = 10000.0):
+    """transformer.rope generalized to PER-ROW positions: x [B, T, H, D],
+    pos_rows [B, T] — identical math (angles = pos·freqs, rotate halves),
+    just with a batched angle table, so batched decode rows at different
+    global positions share one program."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = pos_rows[..., None].astype(jnp.float32) * freqs   # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 def make_kv_decode(n_heads: int, alpha: float = 16.0,
                    dtype=jnp.float32, eps: float = 1e-6,
                    prefill_attn_fn=None):
@@ -159,8 +175,13 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
         if length is None:
             last = x[:, -1]
         else:
-            last = jax.lax.dynamic_index_in_dim(
-                x, length - 1, axis=1, keepdims=False)
+            # per-row real lengths (a scalar broadcasts): each row's last
+            # REAL position feeds the head — batched prompts of different
+            # lengths share one program
+            lengths = jnp.broadcast_to(
+                jnp.asarray(length, jnp.int32), (x.shape[0],))
+            last = jax.vmap(lambda xi, li: jax.lax.dynamic_index_in_dim(
+                xi, li - 1, axis=0, keepdims=False))(x, lengths)
         logits = head_logits(params, top_ads, rank_scale, last[:, None])
         return {"k": ck, "v": cv}, logits[:, 0]
 
@@ -169,19 +190,26 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
         emb = dq(params["embed"]["embedding"])
         x = emb[token][:, None, :]                       # [B, 1, D]
         max_len = cache["k"].shape[2]
-        pos_arr = pos[None] if jnp.ndim(pos) == 0 else pos
+        # pos: per-row write positions [B] (a scalar broadcasts) — batched
+        # rows decode at DIFFERENT global positions
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32),
+                               (token.shape[0],))
 
         def body(x, layer):
             bl, ad_l, ck, cv = layer                     # ck/cv [B,S,H,Dh]
             h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
             q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
-            q, k = rope(q, pos_arr), rope(k, pos_arr)
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+            q = _rope_rows(q, pos[:, None])
+            k = _rope_rows(k, pos[:, None])
+            write = jax.vmap(lambda c, kk, p: jax.lax.dynamic_update_slice(
+                c, kk, (p, 0, 0)))
+            ck = write(ck, k, pos)
+            cv = write(cv, v, pos)
             scale = q.shape[-1] ** -0.5
             s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) * scale
-            live = jnp.arange(max_len) <= pos            # causal + unfilled
-            s = jnp.where(live[None, None, None, :], s, _NEG)
+            # causal + unfilled, per row
+            live = jnp.arange(max_len)[None] <= pos[:, None]       # [B,S]
+            s = jnp.where(live[:, None, None, :], s, _NEG)
             o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), cv)
             x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
                 bl, ad_l, "wo", rank_scale)
@@ -225,15 +253,22 @@ def make_generate(n_heads: int, alpha: float = 16.0,
     def generate(params, adapters, tokens, max_len: int, n_steps: int,
                  length=None, rng=None, temperature=1.0):
         """tokens may be right-padded to a bucket with `length` the real
-        prompt length (traced ok) — the predictor uses this so compiled
-        programs are keyed by (prompt bucket, step bucket), not by every
-        distinct prompt length."""
+        prompt length(s) (traced ok; scalar or per-row [B]) — the
+        predictor uses this so compiled programs are keyed by (prompt
+        bucket, step bucket), not by every distinct prompt length.
+
+        Returns [n_steps] tokens for batch-1 prompts, [B, n_steps] for a
+        batch (rows may have different real lengths; every row decodes
+        n_steps tokens in lockstep through one program)."""
         if rng is None:
             rng = jax.random.key(0)
         cache, logits = prefill(params, adapters, tokens, max_len,
                                 length=length)
         first = pick(logits, jax.random.fold_in(rng, 0), temperature)
-        pos0 = tokens.shape[1] if length is None else length
+        b = tokens.shape[0]
+        pos0 = jnp.broadcast_to(
+            jnp.asarray(tokens.shape[1] if length is None else length,
+                        jnp.int32), (b,))
 
         def one(carry, i):
             cache, tok = carry
@@ -246,8 +281,8 @@ def make_generate(n_heads: int, alpha: float = 16.0,
         # pay one full per-layer pass whose result is discarded)
         (_cache, _tok), rest = jax.lax.scan(
             one, (cache, first), jnp.arange(n_steps - 1))
-        toks = jnp.concatenate([first[None], rest], axis=0)
-        return toks[:, 0]                                    # batch-1
+        toks = jnp.concatenate([first[None], rest], axis=0)  # [n_steps, B]
+        return toks[:, 0] if b == 1 else toks.T
 
     return generate
 

@@ -200,9 +200,20 @@ class GreedyLMPredictor:
         self._generate = generate
 
     def predict(self, input_json: dict) -> dict:
-        toks = list(int(t) for t in input_json["tokens"])
-        if not toks:
-            raise ValueError("tokens must contain at least one prompt token")
+        raw = input_json["tokens"]
+        # {"tokens": [[...], [...]]} = a BATCH of prompts decoded in
+        # lockstep through one program (kv_cache only; rows may differ in
+        # length); {"tokens": [...]} = one prompt
+        batched = bool(raw) and isinstance(raw[0], (list, tuple))
+        rows = [[int(t) for t in r] for r in (raw if batched else [raw])]
+        if not rows or any(not r for r in rows):
+            raise ValueError("tokens must contain at least one prompt token"
+                             " (per row, for a batch)")
+        if batched and not self.kv_cache:
+            raise ValueError(
+                "batched prompts need kv_cache=True (the recompute path "
+                "decodes one prompt per program)")
+        toks = max(rows, key=len)     # longest row drives capacity checks
         new = int(input_json.get("max_new_tokens", 16))
         # fixed-size buffer + bucketed step count => a BOUNDED set of
         # compiled programs (log2(max_len) step buckets). The capacity
@@ -235,8 +246,19 @@ class GreedyLMPredictor:
         if self.kv_cache:
             pbucket = min(_bucket(len(toks), pow2_cap=self.max_len),
                           self.max_len)
-            prompt = np.zeros((1, pbucket), np.int32)
-            prompt[0, : len(toks)] = toks
+            # the row count is ALSO bucketed (dummy rows repeat row 0,
+            # sliced off below): batch sizes 3 and 4 share one compiled
+            # program instead of each minting a fresh prefill+scan compile
+            n_rows = len(rows)
+            bbucket = _bucket(n_rows) if batched else 1
+            prompt = np.zeros((bbucket, pbucket), np.int32)
+            row_lens = []
+            for i in range(bbucket):
+                r = rows[i] if i < n_rows else rows[0]
+                prompt[i, : len(r)] = r
+                row_lens.append(len(r))
+            lengths = (jnp.asarray(row_lens, jnp.int32) if batched
+                       else jnp.int32(len(toks)))
             if temperature > 0:
                 # sampling: softmax(logits/T) with optional static top-k —
                 # T and the seed ride traced (the HF generate() knobs the
@@ -279,19 +301,29 @@ class GreedyLMPredictor:
                     seed = _random.getrandbits(31)
                 out_toks = gen(
                     self.params, self.adapters, jnp.asarray(prompt),
-                    jnp.int32(len(toks)), int(self.max_len), int(steps),
+                    lengths, int(self.max_len), int(steps),
                     jax.random.key(seed), jnp.float32(temperature))
             else:
                 out_toks = self._generate_kv(
                     self.params, self.adapters, jnp.asarray(prompt),
-                    jnp.int32(len(toks)), int(self.max_len), int(steps))
+                    lengths, int(self.max_len), int(steps))
         else:
             buf = np.zeros((1, self.max_len), np.int32)
             buf[0, : len(toks)] = toks
             out_toks = self._generate(self.params, jnp.asarray(buf),
                                       jnp.int32(len(toks)), int(steps))
-        gen = np.asarray(out_toks)[:new].tolist()
-        out = {"generated_tokens": gen}
-        if self.detokenize is not None:
-            out["generated_text"] = self.detokenize(gen)
+        arr = np.asarray(out_toks)
+        if batched:
+            # generate() returns 1-D for a single row; normalize, then
+            # drop the bucket-padding dummy rows
+            arr = np.atleast_2d(arr)[:n_rows]
+            gen = arr[:, :new].tolist()
+            out = {"generated_tokens": gen}
+            if self.detokenize is not None:
+                out["generated_text"] = [self.detokenize(g) for g in gen]
+        else:
+            gen = arr[:new].tolist()
+            out = {"generated_tokens": gen}
+            if self.detokenize is not None:
+                out["generated_text"] = self.detokenize(gen)
         return out
