@@ -1,0 +1,167 @@
+"""Comm-layer microbenchmark: transport round-trip latency + throughput.
+
+(reference: python/tests/grpc_benchmark/ — the reference ships a gRPC vs
+torch-RPC harness with identity/heavy payloads and plot scripts but records
+no numbers, SURVEY §6 row 2. This is the TPU build's analog over its OWN
+transports: loopback, gRPC tensor frames, broker store-and-forward, and
+the content-addressed web3 broker.)
+
+Measures, per backend:
+- rtt_ms: round-trip latency of a tiny echo message (p50 over n iters);
+- throughput_mb_s: one-way goodput of a large float32 tensor payload
+  (wire codec + CRC + transport included — what a federated round's
+  model exchange actually pays).
+
+Run:   python scripts/comm_bench.py [--mb 16] [--iters 50]
+Smoke: tests/test_comm_bench.py runs tiny sizes through every backend.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import uuid
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from fedml_tpu.comm import FedCommManager, Message
+from fedml_tpu.comm.manager import create_transport
+
+ECHO = "bench_echo"
+BULK = "bench_bulk"
+
+
+def _pair(backend: str, run_id: str):
+    kw = {}
+    if backend == "grpc":
+        import socket
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        p0, p1 = free_port(), free_port()
+        table = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
+        a = FedCommManager(create_transport(
+            backend, 0, run_id, ip_table=table, port=p0), 0)
+        b = FedCommManager(create_transport(
+            backend, 1, run_id, ip_table=table, port=p1), 1)
+        return a, b
+    a = FedCommManager(create_transport(backend, 0, run_id, **kw), 0)
+    b = FedCommManager(create_transport(backend, 1, run_id, **kw), 1)
+    return a, b
+
+
+def bench_backend(backend: str, payload_mb: float = 4.0, iters: int = 20,
+                  warmup: int = 3) -> dict:
+    run_id = f"commbench-{uuid.uuid4().hex[:6]}"
+    # grpc port probing races other processes between probe and bind —
+    # retry with fresh ports instead of flaking
+    for attempt in range(3):
+        try:
+            a, b = _pair(backend, run_id)
+            break
+        except Exception:  # noqa: BLE001
+            if attempt == 2:
+                raise
+    got = threading.Event()
+
+    def on_echo_b(msg):             # rank1 echoes straight back
+        m = Message(ECHO, 1, 0)
+        m.add("i", msg.get("i"))
+        b.send_message(m)
+
+    def on_any_a(_msg):
+        got.set()
+
+    b.register_message_receive_handler(ECHO, on_echo_b)
+    b.register_message_receive_handler(
+        BULK, lambda m: (np.asarray(m.get("w")), got.set()))
+    a.register_message_receive_handler(ECHO, on_any_a)
+    a.run(background=True)
+    b.run(background=True)
+
+    # plain raise, not assert: python -O strips asserts and the wait()
+    # INSIDE one would vanish with it, leaving a race instead of a bench
+    def _await(timeout: float, what: str) -> None:
+        if not got.wait(timeout=timeout):
+            raise TimeoutError(f"{backend}: {what} timed out")
+
+    def echo_once(i: int) -> float:
+        got.clear()
+        m = Message(ECHO, 0, 1)
+        m.add("i", i)
+        t0 = time.perf_counter()
+        a.send_message(m)
+        _await(30, f"echo {i}")
+        return time.perf_counter() - t0
+
+    n = max(1, int(payload_mb * 2**20 / 4))
+    w = np.arange(n, dtype=np.float32)
+
+    def bulk_once() -> float:
+        got.clear()
+        m = Message(BULK, 0, 1)
+        m.add("w", w)
+        t0 = time.perf_counter()
+        a.send_message(m)
+        _await(120, "bulk")
+        return time.perf_counter() - t0
+
+    try:
+        for i in range(warmup):
+            echo_once(i)
+        rtts = sorted(echo_once(i) for i in range(iters))
+        rtt_p50 = rtts[len(rtts) // 2]
+        bulk_once()                                # warm codec paths
+        times = [bulk_once() for _ in range(max(3, iters // 5))]
+        best = min(times)
+    finally:
+        # a timeout must not leak servers/threads/registries into the
+        # caller (pytest shares the process across every backend)
+        a.stop()
+        b.stop()
+        if backend == "loopback":
+            from fedml_tpu.comm.loopback import release_router
+
+            release_router(run_id)
+        if backend in ("mqtt_s3", "mqtt", "broker", "mqtt_web3"):
+            from fedml_tpu.comm.broker import release_broker
+
+            release_broker(run_id)
+    return {
+        "backend": backend,
+        "rtt_ms_p50": round(rtt_p50 * 1e3, 3),
+        "payload_mb": round(w.nbytes / 2**20, 2),
+        "throughput_mb_s": round(w.nbytes / 2**20 / best, 1),
+    }
+
+
+BACKENDS = ("loopback", "grpc", "mqtt_s3", "mqtt_web3")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=16.0)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--backends", default=",".join(BACKENDS))
+    args = ap.parse_args()
+    rows = []
+    for be in args.backends.split(","):
+        try:
+            rows.append(bench_backend(be, args.mb, args.iters))
+        except Exception as e:  # noqa: BLE001
+            rows.append({"backend": be,
+                         "error": f"{type(e).__name__}: {e}"[:160]})
+        print(json.dumps(rows[-1]))
+    return 0 if all("error" not in r for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

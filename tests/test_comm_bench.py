@@ -1,0 +1,23 @@
+"""Comm-layer microbenchmark harness (scripts/comm_bench.py) — the analog
+of the reference's grpc_benchmark tests (python/tests/grpc_benchmark/,
+SURVEY §6 row 2): every transport measures echo latency and bulk goodput
+without hanging or corrupting payloads."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "scripts"))
+
+from comm_bench import BACKENDS, bench_backend
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_comm_bench_smoke(backend):
+    if backend == "grpc":
+        pytest.importorskip("grpc")
+    row = bench_backend(backend, payload_mb=0.25, iters=5, warmup=1)
+    assert row["backend"] == backend
+    assert row["rtt_ms_p50"] > 0
+    assert row["throughput_mb_s"] > 0
+    assert row["payload_mb"] == 0.25
