@@ -68,7 +68,10 @@ class CentralizedTrainer:
         self.opt_state = self.opt.init(self.params)
         self.objective = make_objective(t.extra.get("task"))
         self._train = jax.jit(self._epoch)
-        self._eval = jax.jit(eval_step_fn(self.apply_fn, self.objective))
+        from ..core.algorithm import make_eval_fn
+
+        self._eval = make_eval_fn(self.apply_fn, t.extra.get("task"),
+                                  self.dataset.num_classes)
         self.history: list[dict] = []
 
     def _epoch(self, params, opt_state, rng):
@@ -90,7 +93,10 @@ class CentralizedTrainer:
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64))
         m = jax.device_get(self._eval(
             self.params, jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb)))
-        return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        out = {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        if "miou" in m:                    # segmentation task head
+            out["test_miou"] = float(m["miou"])
+        return out
 
     def run(self, epochs: Optional[int] = None) -> list[dict]:
         t = self.cfg.train_args

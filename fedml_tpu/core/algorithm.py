@@ -331,6 +331,22 @@ def seg_eval_fn(apply_fn: Callable, num_classes: int,
     return eval_batches
 
 
+def make_eval_fn(apply_fn: Callable, task: Optional[str] = None,
+                 num_classes: Optional[int] = None):
+    """Task-aware eval factory — ONE dispatch shared by every engine
+    (Simulator, AsyncSimulator, centralized Trainer), so a segmentation
+    config gets the whole-set confusion-matrix evaluator (mIoU rides the
+    eval row) everywhere instead of only where someone special-cased it.
+    Returns eval(params, x, y, mask) over batched test arrays."""
+    if (task or "").lower() == "segmentation":
+        if num_classes is None:
+            raise ValueError(
+                "segmentation eval needs num_classes (the confusion matrix "
+                "shape)")
+        return seg_eval_fn(apply_fn, num_classes)
+    return jax.jit(eval_step_fn(apply_fn, make_objective(task)))
+
+
 def eval_step_fn(apply_fn: Callable, objective: Optional[Callable] = None):
     """Batched, jittable eval over the global test set (reference:
     `test_on_server_for_all_clients`, cross_silo/server/fedml_aggregator.py).

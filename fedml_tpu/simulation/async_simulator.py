@@ -109,7 +109,10 @@ class AsyncSimulator:
 
         self._train_one = jax.jit(train_one)
         self._merge = jax.jit(merge)
-        self._eval = jax.jit(eval_step_fn(apply_fn, objective))
+        from ..core.algorithm import make_eval_fn
+
+        self._eval = make_eval_fn(apply_fn, t.extra.get("task"),
+                                  self.dataset.num_classes)
         xb, yb, mb = _pad_test_batches(
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64))
         self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
@@ -121,7 +124,10 @@ class AsyncSimulator:
 
     def evaluate(self) -> dict:
         m = jax.device_get(self._eval(self.params, *self._test))
-        return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        out = {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        if "miou" in m:                    # segmentation task head
+            out["test_miou"] = float(m["miou"])
+        return out
 
     def run(self, num_updates: Optional[int] = None) -> list[dict]:
         t = self.cfg.train_args

@@ -193,10 +193,13 @@ class Simulator:
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
         )
         self._test = (jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb))
-        from ..core.algorithm import make_objective
+        from ..core.algorithm import make_eval_fn
 
-        self._eval = jax.jit(eval_step_fn(
-            self.apply_fn, make_objective(t.extra.get("task"))))
+        # task-aware: segmentation evaluates through the whole-set
+        # confusion-matrix evaluator so mIoU rides the eval row (FedSeg
+        # parity — the reference server evaluates mIoU every round)
+        self._eval = make_eval_fn(self.apply_fn, t.extra.get("task"),
+                                  self.num_classes)
         self.history: list[dict] = []
 
     # reference parity: np seeded by round index (fedavg_api.py:127-135)
@@ -263,7 +266,10 @@ class Simulator:
         with recorder.span("eval"):
             params = self.server_state.params
             m = jax.device_get(self._eval(params, *self._test))
-        return {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        out = {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
+        if "miou" in m:                    # segmentation task head
+            out["test_miou"] = float(m["miou"])
+        return out
 
     # ---------------------------------------------------- checkpoint/resume
     # (beyond the reference: a killed reference run restarts from round 0 —

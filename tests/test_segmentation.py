@@ -151,3 +151,5 @@ def test_fedseg_config_driven_through_simulator():
     assert losses[-1] < losses[0], losses
     ev = sim.evaluate()
     assert ev["test_acc"] > 0.5, ev        # pixel acc over 21 classes
+    # seg runs report whole-set mIoU through the standard eval row
+    assert "test_miou" in ev and 0.0 <= ev["test_miou"] <= 1.0, ev
