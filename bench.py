@@ -81,6 +81,25 @@ def bench_tpu():
     dt = time.perf_counter() - t0
     rps = MEASURE_ROUNDS / dt
 
+    # round-block execution on the same workload: K rounds scanned inside one
+    # XLA program, pipelined driver (ISSUE 1). Warm with one run (pays the
+    # block compile), then time a second — the acceptance bar is "flagship
+    # does not regress" vs the per-round figure above.
+    blocked_rps = None
+    try:
+        k = MEASURE_ROUNDS
+        cfg_b = fedml_tpu.init(config=_flagship_config(backend))
+        cfg_b.data_args.extra["synthetic_samples_per_client"] = SHARD
+        cfg_b.train_args.extra["rounds_per_block"] = k
+        sim_b = Simulator(cfg_b)
+        sim_b.run(k)                       # compile + warm (one block)
+        t0 = time.perf_counter()
+        sim_b.run(k)
+        blocked_rps = k / (time.perf_counter() - t0)
+    except Exception as e:  # noqa: BLE001
+        print(f"flagship blocked bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Analytical matmul+conv FLOPs of ONE execution of the exact round
     # program that was just timed — traced via make_jaxpr, scan bodies
     # multiplied by trip count (utils/flops.py). Nothing is extrapolated,
@@ -101,7 +120,8 @@ def bench_tpu():
     except Exception as e:  # noqa: BLE001
         print(f"analytic flops failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-    return rps, dt / MEASURE_ROUNDS, flops, bool(sim.dataset.synthetic)
+    return rps, dt / MEASURE_ROUNDS, flops, bool(sim.dataset.synthetic), \
+        blocked_rps
 
 
 def measured_matmul_peak_tflops() -> float:
@@ -261,11 +281,29 @@ def bench_workload1_mnist_lr() -> dict:
     for r in range(1, n + 1):
         sim.run_round(r)
     dt = time.perf_counter() - t0
-    return {
+    out = {
         "w1_mnist_lr_sp_rounds_per_sec": round(n / dt, 2),
         "w1_round_time_ms": round(dt / n * 1e3, 1),
         "w1_data_synthetic": bool(sim.dataset.synthetic),
     }
+    # round-block execution (ISSUE 1): this workload is where the host-
+    # synchronous driver dominates (round program ≪ dispatch + device_get +
+    # host scheduling), so K=8 blocks are the acceptance row — bar: ≥ 2×
+    # the per-round figure above
+    try:
+        k, n_blocked = 8, 32
+        cfg.train_args.extra["rounds_per_block"] = k
+        sim_b = Simulator(cfg)
+        sim_b.run(k)                       # compile + warm (one block)
+        t0 = time.perf_counter()
+        sim_b.run(n_blocked)
+        dt_b = time.perf_counter() - t0
+        out["w1_blocked_rounds_per_sec"] = round(n_blocked / dt_b, 2)
+        out["w1_blocked_rounds_per_block"] = k
+        out["w1_blocked_speedup"] = round((n_blocked / dt_b) / (n / dt), 2)
+    except Exception as e:  # noqa: BLE001
+        out["w1_blocked_error"] = f"{type(e).__name__}: {e}"[:120]
+    return out
 
 
 def bench_workload4_hierarchical() -> dict:
@@ -821,8 +859,11 @@ _HEADLINE_KEYS = (
     # accuracy parity on real data
     "parity_acc_delta", "real_data_final_acc_digits_noniid",
     "reference_torch_acc_same_partitions",
+    # round-block execution (ISSUE 1): blocked flagship + w1 acceptance rows
+    "blocked_rounds_per_sec",
     # workloads 1 and 4
-    "w1_mnist_lr_sp_rounds_per_sec", "w4_hier_round_time_ms",
+    "w1_mnist_lr_sp_rounds_per_sec", "w1_blocked_rounds_per_sec",
+    "w1_blocked_speedup", "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",
     "fedllm_1b_params",
@@ -856,8 +897,8 @@ def _headline(full: dict, budget: int = _HEADLINE_BUDGET) -> dict:
 
 def main():
     quick = "--quick" in sys.argv
-    tpu_rps, round_time, flops, synthetic = _retrying(
-        bench_tpu, default=(None, None, None, None))
+    tpu_rps, round_time, flops, synthetic, blocked_rps = _retrying(
+        bench_tpu, default=(None, None, None, None, None))
     if tpu_rps is None:
         print(json.dumps({"metric": "fedavg_rounds_per_sec_100clients_"
                           "resnet18_cifar10", "value": None,
@@ -906,6 +947,7 @@ def main():
         "unit": "rounds/sec",
         "vs_baseline": round(tpu_rps / base_rps, 2) if base_rps else None,
         "round_time_ms": round(round_time * 1e3, 1),
+        "blocked_rounds_per_sec": round(blocked_rps, 4) if blocked_rps else None,
         "flops_per_round_analytic": flops,
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "device_kind": jax.devices()[0].device_kind,

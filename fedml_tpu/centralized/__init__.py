@@ -47,9 +47,13 @@ class CentralizedTrainer:
     def __init__(self, cfg: Config, dataset: Optional[FedDataset] = None,
                  model=None):
         from ..data import loader as data_loader
+        from ..utils import maybe_enable_compilation_cache
 
         self.cfg = cfg
         t = cfg.train_args
+        # before the first trace: repeated runs reuse on-disk compiled
+        # programs when common_args.extra.compilation_cache_dir is set
+        maybe_enable_compilation_cache(cfg)
         self.dataset = dataset if dataset is not None else data_loader.load(cfg)
         self.model = model if model is not None else model_hub.create(
             cfg.model_args.model, self.dataset.num_classes,

@@ -264,6 +264,22 @@ class Config:
             )
         if t.comm_round < 1 or t.epochs < 1 or t.batch_size < 1:
             raise ValueError("comm_round, epochs and batch_size must be >= 1")
+        # round-block execution knobs (simulation/simulator.py): K rounds
+        # scanned inside one XLA program, a bounded number of blocks in
+        # flight. Validated here so a typo'd YAML fails at load, not as a
+        # shape error K rounds into a run.
+        for knob, lo in (("rounds_per_block", 1), ("block_pipeline_depth", 1)):
+            val = t.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = int(val) >= lo and int(val) == float(val)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"train_args.{knob} must be an integer >= {lo}; "
+                    f"got {val!r}")
         if self.common_args.training_type not in (
             TRAINING_TYPE_SIMULATION,
             TRAINING_TYPE_CROSS_SILO,

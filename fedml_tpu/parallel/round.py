@@ -29,7 +29,11 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # newer jax exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover — jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map
 
 from ..core.algorithm import FULL, ClientMetrics, FedAlgorithm, ServerState
 from ..ops import tree as tu
@@ -42,8 +46,10 @@ def _localize(tree: Pytree, axis: str) -> Pytree:
     so gradients w.r.t. them stay per-device instead of auto-psum'd."""
     if hasattr(jax.lax, "pcast"):  # jax >= 0.9
         cast = lambda x: jax.lax.pcast(x, (axis,), to="varying")
-    else:  # pragma: no cover
+    elif hasattr(jax.lax, "pvary"):  # pragma: no cover
         cast = lambda x: jax.lax.pvary(x, (axis,))
+    else:  # pragma: no cover — jax <= 0.4.x: no replication casting; body-
+        return tree  # level grads are already per-device under shard_map
     return jax.tree.map(lambda x: cast(x) if hasattr(x, "dtype") else x, tree)
 
 
@@ -54,7 +60,7 @@ class RoundOutput(NamedTuple):
     hook_state: Pytree = None      # defense/plugin state threaded across rounds
 
 
-def build_round_fn(
+def _make_round_body(
     alg: FedAlgorithm,
     mesh: Optional[Mesh] = None,
     axis: str = "clients",
@@ -64,7 +70,8 @@ def build_round_fn(
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
 ) -> Callable:
-    """Build the jitted round function.
+    """Build the traceable round body shared by `build_round_fn` (one round
+    per jit call) and `build_block_fn` (K rounds scanned inside one jit).
 
     round_fn(server_state, full_client_states, data, ids, weights, rng,
              hook_state) -> RoundOutput
@@ -245,9 +252,80 @@ def build_round_fn(
             )
         return finalize(server_state, agg, summed, full_cstates, hook_state)
 
+    return round_body
+
+
+def build_round_fn(
+    alg: FedAlgorithm,
+    mesh: Optional[Mesh] = None,
+    axis: str = "clients",
+    group_size: int = 1,
+    aggregate_full: Optional[Callable[[Pytree, jax.Array, dict], tuple]] = None,
+    postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
+    num_real_clients: Optional[int] = None,
+) -> Callable:
+    """Build the jitted single-round function (see `_make_round_body` for the
+    argument contract)."""
+    round_body = _make_round_body(
+        alg, mesh, axis, group_size, aggregate_full, postprocess_update,
+        postprocess_agg, num_real_clients,
+    )
     # donate server/client/hook state: all three are dead after the call, and
     # the hook state can be a [N, D] defense history that must update in place
     return jax.jit(round_body, donate_argnums=(0, 1, 6))
+
+
+def build_block_fn(
+    alg: FedAlgorithm,
+    mesh: Optional[Mesh] = None,
+    axis: str = "clients",
+    group_size: int = 1,
+    aggregate_full: Optional[Callable[[Pytree, jax.Array, dict], tuple]] = None,
+    postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
+    num_real_clients: Optional[int] = None,
+) -> Callable:
+    """Build the jitted ROUND-BLOCK function: K federated rounds as one XLA
+    program, `lax.scan` over the exact same round body `build_round_fn` jits.
+
+    block_fn(server_state, full_client_states, data, ids, weights, base_rng,
+             rounds, hook_state) -> RoundOutput
+    where ids/weights are the host-precomputed schedules stacked to [K, m]
+    (round-seeded sampling + `_pad_ids` padding + LPT balancing run on the
+    host exactly as in per-round mode), rounds is the [K] int32 vector of
+    global round indices, and base_rng is the run's root PRNG key. The body
+    derives each round's key as `fold_in(base_rng, round_idx)` — bit-for-bit
+    the key the per-round driver passes — so a K-block scan replays K
+    individual rounds exactly, while paying ONE dispatch and returning
+    stacked [K] metrics for ONE host transfer per block.
+
+    K is baked into the program via the leading axis of `ids`; callers must
+    keep the block shape fixed across calls (the simulator runs ragged tail
+    blocks through the per-round path) or pay a retrace per distinct K.
+    """
+    round_body = _make_round_body(
+        alg, mesh, axis, group_size, aggregate_full, postprocess_update,
+        postprocess_agg, num_real_clients,
+    )
+
+    def block_body(server_state, full_cstates, data, ids, weights, base_rng,
+                   rounds, hook_state):
+        def step(carry, xs):
+            st, cs, hs = carry
+            ids_r, w_r, r = xs
+            out = round_body(st, cs, data, ids_r, w_r,
+                             jax.random.fold_in(base_rng, r), hs)
+            return (out.server_state, out.client_states, out.hook_state), \
+                out.metrics
+        (st, cs, hs), metrics = jax.lax.scan(
+            step, (server_state, full_cstates, hook_state),
+            (ids, weights, rounds))
+        return RoundOutput(st, cs, metrics, hs)
+
+    # same donation contract as the single-round program; the scan carry
+    # aliases the donated buffers so K rounds update state in place
+    return jax.jit(block_body, donate_argnums=(0, 1, 7))
 
 
 def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:

@@ -23,6 +23,7 @@ tokens (parity-pinned in tests/test_kv_decode.py).
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable, Optional, Protocol
 
 import jax
@@ -179,6 +180,11 @@ class GreedyLMPredictor:
             self._generate_kv = generate_kv
             self._kv_dtype = kv_dtype
             self._samplers: dict = {}   # top_k -> jitted sampling generate
+            # FedMLInferenceRunner serves via ThreadingHTTPServer, so two
+            # first requests for the same top_k bucket can race here; without
+            # the lock each would build + jit its own generate wrapper — a
+            # duplicate multi-minute XLA compile at large model scale
+            self._samplers_lock = threading.Lock()
             return
 
         # n_steps is a Python int at trace time (scan length must be
@@ -274,22 +280,23 @@ class GreedyLMPredictor:
                         f"{top_k} (0 disables the cutoff)")
                 if top_k:
                     top_k = min(_bucket(top_k, pow2_cap=vocab), vocab)
-                gen = self._samplers.get(top_k)
-                if gen is None:
-                    from ..llm.decode import make_generate
+                with self._samplers_lock:
+                    gen = self._samplers.get(top_k)
+                    if gen is None:
+                        from ..llm.decode import make_generate
 
-                    kv_gen = make_generate(self.model.n_heads,
-                                           dtype=self._kv_dtype,
-                                           sample=True, top_k=top_k)
+                        kv_gen = make_generate(self.model.n_heads,
+                                               dtype=self._kv_dtype,
+                                               sample=True, top_k=top_k)
 
-                    @functools.partial(jax.jit, static_argnums=(4, 5))
-                    def gen(params, adapters, tokens, length, max_len,
-                            n_steps, rng, temp):
-                        return kv_gen(params, adapters, tokens, max_len,
-                                      n_steps, length=length, rng=rng,
-                                      temperature=temp)
+                        @functools.partial(jax.jit, static_argnums=(4, 5))
+                        def gen(params, adapters, tokens, length, max_len,
+                                n_steps, rng, temp):
+                            return kv_gen(params, adapters, tokens, max_len,
+                                          n_steps, length=length, rng=rng,
+                                          temperature=temp)
 
-                    self._samplers[top_k] = gen
+                        self._samplers[top_k] = gen
                 # no client seed -> a fresh one per request, so repeated
                 # sampling requests VARY (the normal serving contract);
                 # pass "seed" explicitly for reproducible generations

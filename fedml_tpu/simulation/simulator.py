@@ -10,6 +10,7 @@ server step — is ONE jitted XLA program per round (parallel/round.py).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import jax
@@ -28,7 +29,8 @@ from ..models import hub as model_hub
 from ..ops import tree as tu
 from ..parallel.mesh import make_mesh
 from .. import schedule as lpt_sched
-from ..parallel.round import build_round_fn, shard_fed_data
+from ..parallel.round import build_block_fn, build_round_fn, shard_fed_data
+from ..utils import maybe_enable_compilation_cache
 from ..utils.events import recorder
 
 
@@ -70,6 +72,9 @@ class Simulator:
                  model=None, mesh=None):
         self.cfg = cfg
         t = cfg.train_args
+        # before the first trace: repeated runs reuse on-disk compiled
+        # programs when common_args.extra.compilation_cache_dir is set
+        maybe_enable_compilation_cache(cfg)
         self.dataset = dataset if dataset is not None else data_loader.load(cfg)
         self.num_classes = self.dataset.num_classes
 
@@ -145,12 +150,16 @@ class Simulator:
 
         self._schedule = bool(t.extra.get("heterogeneity_schedule", True))
         group = int(t.extra.get("clients_per_device_parallel", 1))
-        self.round_fn = build_round_fn(
-            self.alg, self.mesh, group_size=group,
+        # one kwargs dict drives BOTH engines: the per-round program and the
+        # K-round scanned block program trace the identical round body
+        self._round_kwargs = dict(
+            mesh=self.mesh, group_size=group,
             aggregate_full=agg_full, postprocess_update=post_update,
             postprocess_agg=post_agg,
             num_real_clients=t.client_num_per_round,
         )
+        self.round_fn = build_round_fn(self.alg, **self._round_kwargs)
+        self.block_fn = None   # built lazily on the first blocked dispatch
         self.hook_state = sec_mod.init_pipeline_state(
             self.attacker, self.defender, self.params, t.client_num_per_round
         ) if agg_full is not None else None
@@ -211,17 +220,13 @@ class Simulator:
         np.random.seed(round_idx)
         return np.sort(np.random.choice(range(n), m, replace=False)).astype(np.int32)
 
-    def _pad_ids(self, ids: np.ndarray):
+    def _pad_only(self, ids: np.ndarray):
         """Pad sampled ids to a multiple of the mesh size with zero-weight
-        duplicates so shard_map shapes stay static, then balance per-device
-        load with the Parrot scheduler (reference:
-        FedAVGAggregator.generate_client_schedule, fedavg_seq:126-187 —
-        uniform chunks would put all heavy clients on one chip when the
-        dataset is skewed; balanced LPT permutes clients among the equal-size
-        device slots so per-chip useful-sample load is even)."""
+        duplicates so shard_map shapes stay static. Returns
+        (padded_ids, weights, pad)."""
         weights = np.asarray(self.counts)[ids].astype(np.float32)
         if self.mesh is None:
-            return ids, weights
+            return ids, weights, 0
         d = self.mesh.devices.size
         pad = (-len(ids)) % d
         if pad:
@@ -231,16 +236,52 @@ class Simulator:
             # persistent state (SCAFFOLD c_i / FedDyn h_i) on unsampled rounds
             ids = np.concatenate([ids, np.full(pad, ids[0], np.int32)])
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
-        # FULL-mode aggregation slices the real clients back out as a prefix
-        # (round.py call_full, num_real_clients); a permutation that moves pad
-        # duplicates into that prefix would silently drop real updates — skip
-        # scheduling whenever both padding and FULL hooks are in play.
+        return ids, weights, pad
+
+    def _lpt_applies(self, weights: np.ndarray, pad: int) -> bool:
+        """Whether one round's padded id row gets the balanced-LPT permute.
+        FULL-mode aggregation slices the real clients back out as a prefix
+        (round.py call_full, num_real_clients); a permutation that moves pad
+        duplicates into that prefix would silently drop real updates — skip
+        scheduling whenever both padding and FULL hooks are in play."""
+        if self.mesh is None:
+            return False
+        d = self.mesh.devices.size
         schedulable = pad == 0 or not self._use_full
-        if self._schedule and schedulable and len(ids) > d \
-                and len(np.unique(weights)) > 1:
-            blocks = lpt_sched.balanced_lpt(weights, d)
+        return bool(self._schedule and schedulable and len(weights) > d
+                    and len(np.unique(weights)) > 1)
+
+    def _pad_ids(self, ids: np.ndarray):
+        """Pad sampled ids to a multiple of the mesh size with zero-weight
+        duplicates so shard_map shapes stay static, then balance per-device
+        load with the Parrot scheduler (reference:
+        FedAVGAggregator.generate_client_schedule, fedavg_seq:126-187 —
+        uniform chunks would put all heavy clients on one chip when the
+        dataset is skewed; balanced LPT permutes clients among the equal-size
+        device slots so per-chip useful-sample load is even)."""
+        ids, weights, pad = self._pad_only(ids)
+        if self._lpt_applies(weights, pad):
+            blocks = lpt_sched.balanced_lpt(weights, self.mesh.devices.size)
             perm = np.concatenate([np.asarray(b, int) for b in blocks])
             ids, weights = ids[perm], weights[perm]
+        return ids, weights
+
+    def _schedule_block(self, rounds):
+        """The host half of round-block execution: the [K, m] id/weight
+        schedule for a block of rounds. Per-round seeded sampling and mesh
+        padding run exactly as `_pad_ids` (reference parity is bit-for-bit),
+        then ONE vectorized balanced-LPT pass (schedule.balanced_lpt_block)
+        permutes every schedulable row at once — the host's only remaining
+        per-round job, amortized to one numpy pass per block."""
+        trips = [self._pad_only(self.sample_clients(r)) for r in rounds]
+        ids = np.stack([i for i, _, _ in trips])
+        weights = np.stack([w for _, w, _ in trips])
+        rows = np.flatnonzero([self._lpt_applies(w, p) for _, w, p in trips])
+        if rows.size:
+            perms = lpt_sched.balanced_lpt_block(
+                weights[rows], self.mesh.devices.size)
+            ids[rows] = np.take_along_axis(ids[rows], perms, axis=1)
+            weights[rows] = np.take_along_axis(weights[rows], perms, axis=1)
         return ids, weights
 
     def run_round(self, round_idx: int) -> dict:
@@ -262,14 +303,23 @@ class Simulator:
             metrics["dp_epsilon"] = self.dp.get_epsilon()
         return metrics
 
-    def evaluate(self) -> dict:
-        with recorder.span("eval"):
-            params = self.server_state.params
-            m = jax.device_get(self._eval(params, *self._test))
+    def _eval_dispatch(self):
+        """Enqueue the test-set eval program; returns un-materialized device
+        values (JAX async dispatch — the caller fetches them later, so the
+        blocked driver can keep training blocks in flight behind an eval)."""
+        return self._eval(self.server_state.params, *self._test)
+
+    @staticmethod
+    def _eval_finish(m) -> dict:
+        m = jax.device_get(m)
         out = {"test_loss": float(m["loss"]), "test_acc": float(m["acc"])}
         if "miou" in m:                    # segmentation task head
             out["test_miou"] = float(m["miou"])
         return out
+
+    def evaluate(self) -> dict:
+        with recorder.span("eval"):
+            return self._eval_finish(self._eval_dispatch())
 
     # ---------------------------------------------------- checkpoint/resume
     # (beyond the reference: a killed reference run restarts from round 0 —
@@ -309,10 +359,167 @@ class Simulator:
             self.dp.accountant.steps = rounds_done
         return rounds_done
 
+    # ------------------------------------------------------------ run loop
+    def _eval_due(self, r: int, rounds: int) -> bool:
+        f = self.cfg.validation_args.frequency_of_the_test
+        return bool(f) and (r % f == 0 or r == rounds - 1)
+
+    @staticmethod
+    def _ckpt_due(r: int, rounds: int, checkpoint_dir, checkpoint_every) -> bool:
+        return checkpoint_dir is not None and bool(checkpoint_every) and (
+            (r + 1) % checkpoint_every == 0 or r == rounds - 1)
+
+    def _publish_model(self, r: int, params) -> None:
+        """Aggregated-model publish (reference: the aggregator calls
+        mlops.log_aggregated_model_info every round —
+        core/mlops/__init__.py:388); no-op unless an artifact store is
+        configured via mlops.init/set_artifact_store. Degrade, don't die:
+        like the telemetry sinks, a store hiccup must not kill a long
+        training run."""
+        from .. import mlops
+
+        try:
+            mlops.log_aggregated_model_info(r, params)
+        except Exception as e:  # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "round-%d model-artifact publish failed (continuing): "
+                "%s: %s", r, type(e).__name__, e)
+
+    def _run_one(self, r: int, rounds: int) -> None:
+        """One host-synchronous round: train, eval on cadence, log, publish."""
+        row = {"round": r, **self.run_round(r)}
+        if self._eval_due(r, rounds):
+            row.update(self.evaluate())
+        recorder.log(row)
+        self.history.append(row)
+        self._publish_model(r, self.server_state.params)
+
+    # ------------------------------------------------- round-block pipeline
+    def _dispatch_block(self, blk: list[int], base_rng, rounds: int):
+        """Enqueue one K-round block program plus whatever must read its
+        output params (eval, artifact snapshot) BEFORE the next dispatch
+        donates them. Nothing here blocks on the device."""
+        if self.block_fn is None:
+            self.block_fn = build_block_fn(self.alg, **self._round_kwargs)
+        ids, weights = self._schedule_block(blk)
+        t0 = time.perf_counter()
+        out = self.block_fn(
+            self.server_state, self.client_states, self.data,
+            jnp.asarray(ids), jnp.asarray(weights), base_rng,
+            jnp.asarray(blk, dtype=jnp.int32), self.hook_state,
+        )
+        self.server_state = out.server_state
+        self.client_states = out.client_states
+        self.hook_state = out.hook_state
+        eval_out = (self._eval_dispatch()
+                    if self._eval_due(blk[-1], rounds) else None)
+        # per-round publishes degrade to one per block in blocked mode
+        # (intermediate params never materialize); snapshot on device so the
+        # next block's donation can't free the buffers under the store
+        from .. import mlops
+
+        snap = (jax.tree.map(jnp.copy, out.server_state.params)
+                if mlops.artifact_store() is not None else None)
+        return (blk, out.metrics, eval_out, snap, t0)
+
+    def _drain_block(self, pending) -> None:
+        """Materialize one dispatched block: ONE host transfer for the
+        stacked [K] metrics, then per-round history rows exactly as the
+        per-round driver writes them (DP accountant advanced K times, each
+        round's epsilon computed at its own composition count). The block's
+        "train" span covers dispatch→materialization — the async dispatch
+        returns in microseconds, so timing the dispatch alone would report
+        near-zero per-round durations to the sinks."""
+        blk, metrics, eval_out, snap, t0 = pending
+        m = jax.device_get(metrics)
+        recorder.log_block_span("train", blk, time.perf_counter() - t0)
+        for j, r in enumerate(blk):
+            row = {"round": r}
+            row.update({k: float(v[j]) for k, v in m.items()})
+            self.dp.step_round()
+            if self.dp.enabled and self.dp.accountant is not None:
+                row["dp_epsilon"] = self.dp.get_epsilon()
+            if eval_out is not None and r == blk[-1]:
+                # keep the "eval" span series alive in blocked mode: the
+                # program was async-dispatched back in _dispatch_block, so
+                # what's measurable here is the host's materialization wait
+                # (flagged block:true like the train rows)
+                te = time.perf_counter()
+                row.update(self._eval_finish(eval_out))
+                recorder.log_block_span("eval", [r],
+                                        time.perf_counter() - te)
+            recorder.log(row)
+            self.history.append(row)
+        if snap is not None:
+            self._publish_model(blk[-1], snap)
+
+    def _run_blocked(self, start: int, rounds: int, block_size: int,
+                     checkpoint_dir, checkpoint_every) -> None:
+        """Pipelined round-block driver: K rounds per XLA dispatch, block
+        i+1 dispatched before block i's metrics are fetched (JAX async
+        dispatch keeps the device busy across the host's schedule/LPT work).
+        Blocks never span an eval/checkpoint round, so blocked and per-round
+        runs produce identical history; ragged tails (cadence not a multiple
+        of K, end of horizon) fall back to the per-round program instead of
+        minting one block compile per distinct length."""
+        from collections import deque
+
+        t = self.cfg.train_args
+        depth = max(1, int(t.extra.get("block_pipeline_depth", 2) or 1))
+        # a barrier cadence shorter than the block size means no block ever
+        # fills — the whole run would silently execute the per-round program
+        # at 1x while the config claims blocked mode; say so once up front
+        cadences = [c for c in (
+            self.cfg.validation_args.frequency_of_the_test,
+            checkpoint_every if checkpoint_dir is not None else 0,
+        ) if c]
+        if cadences and min(cadences) < block_size:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "rounds_per_block=%d exceeds the eval/checkpoint cadence "
+                "(%d): blocks between barriers never fill, so most or all "
+                "rounds will run the per-round program; lower "
+                "rounds_per_block or raise the cadence to get blocked "
+                "throughput", block_size, min(cadences))
+        base_rng = jax.random.key(self.cfg.common_args.random_seed)
+        pending: deque = deque()
+
+        def drain_all():
+            while pending:
+                self._drain_block(pending.popleft())
+
+        blk: list[int] = []
+        for r in range(start, rounds):
+            blk.append(r)
+            barrier = self._eval_due(r, rounds) or self._ckpt_due(
+                r, rounds, checkpoint_dir, checkpoint_every)
+            if not barrier and len(blk) < block_size:
+                continue
+            if len(blk) == block_size:
+                pending.append(self._dispatch_block(blk, base_rng, rounds))
+                while len(pending) >= depth:
+                    self._drain_block(pending.popleft())
+            else:
+                drain_all()
+                for rr in blk:
+                    self._run_one(rr, rounds)
+            blk = []
+            if self._ckpt_due(r, rounds, checkpoint_dir, checkpoint_every):
+                drain_all()
+                self.save(checkpoint_dir)
+        if blk:   # ragged tail with no barrier at the horizon end
+            drain_all()
+            for rr in blk:
+                self._run_one(rr, rounds)
+        drain_all()
+
     def run(self, num_rounds: Optional[int] = None,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 0) -> list[dict]:
-        t, v = self.cfg.train_args, self.cfg.validation_args
+        t = self.cfg.train_args
         rounds = num_rounds if num_rounds is not None else t.comm_round
         start = 0
         if checkpoint_dir is not None:
@@ -320,34 +527,16 @@ class Simulator:
 
             if latest_round(checkpoint_dir) is not None:
                 start = self.restore(checkpoint_dir)
-        for r in range(start, rounds):
-            row = {"round": r, **self.run_round(r)}
-            if v.frequency_of_the_test and (
-                r % v.frequency_of_the_test == 0 or r == rounds - 1
-            ):
-                row.update(self.evaluate())
-            recorder.log(row)
-            self.history.append(row)
-            # per-round aggregated-model publish (reference: the aggregator
-            # calls mlops.log_aggregated_model_info every round —
-            # core/mlops/__init__.py:388); no-op unless an artifact store
-            # is configured via mlops.init/set_artifact_store. Degrade,
-            # don't die: like the telemetry sinks, a store hiccup must not
-            # kill a long training run
-            from .. import mlops
-
-            try:
-                mlops.log_aggregated_model_info(r, self.server_state.params)
-            except Exception as e:  # noqa: BLE001
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "round-%d model-artifact publish failed (continuing): "
-                    "%s: %s", r, type(e).__name__, e)
-            if checkpoint_dir is not None and checkpoint_every and (
-                (r + 1) % checkpoint_every == 0 or r == rounds - 1
-            ):
-                self.save(checkpoint_dir)
+        block_size = max(1, int(t.extra.get("rounds_per_block", 1) or 1))
+        if block_size > 1:
+            self._run_blocked(start, rounds, block_size,
+                              checkpoint_dir, checkpoint_every)
+        else:
+            for r in range(start, rounds):
+                self._run_one(r, rounds)
+                if self._ckpt_due(r, rounds, checkpoint_dir,
+                                  checkpoint_every):
+                    self.save(checkpoint_dir)
         from ..utils.sinks import flush_sinks
 
         flush_sinks()  # ship any buffered telemetry (BrokerLogSink batches)

@@ -1,0 +1,44 @@
+"""Shared runtime utilities."""
+from __future__ import annotations
+
+import logging
+
+_cache_enabled_for: str | None = None
+
+
+def maybe_enable_compilation_cache(cfg) -> bool:
+    """Opt-in persistent XLA compilation cache: when
+    `common_args.extra["compilation_cache_dir"]` is set, point jax's
+    on-disk cache there so repeated runs (bench reruns, CI, resumed
+    training) skip recompiles of unchanged programs. Called at
+    simulator/trainer startup; returns True when the cache is active.
+
+    Degrades instead of dying: a jax build without the knob (or an
+    unwritable directory — jax only probes it lazily) logs a warning and
+    runs uncached, because losing a training run to a cache misconfig
+    would be strictly worse than recompiling.
+    """
+    global _cache_enabled_for
+    cache_dir = cfg.common_args.extra.get("compilation_cache_dir")
+    if not cache_dir:
+        return False
+    cache_dir = str(cache_dir)
+    if _cache_enabled_for == cache_dir:
+        return True
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: the round-block program is cheap to
+        # compile on CPU meshes but multi-minute on remote-TPU tunnels
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        except Exception:  # noqa: BLE001 — knob name varies across versions
+            pass
+        _cache_enabled_for = cache_dir
+        return True
+    except Exception as e:  # noqa: BLE001
+        logging.getLogger(__name__).warning(
+            "compilation_cache_dir=%r could not be enabled (continuing "
+            "uncached): %s: %s", cache_dir, type(e).__name__, e)
+        return False

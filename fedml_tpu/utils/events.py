@@ -56,6 +56,29 @@ class EventRecorder:
             for sink in self.sinks:
                 sink("span", {"name": name, "duration": s.duration, **meta})
 
+    def log_block_span(self, name: str, rounds, duration: float, **meta):
+        """Record a span over a round BLOCK (round-block execution runs K
+        rounds as one async-dispatched XLA program, so the caller measures
+        dispatch→materialization itself and reports it here): ONE span
+        tagged with the covered round range, plus one sink row PER ROUND
+        with the amortized duration — per-round dashboards keep their
+        cadence when the engine stops paying per-round dispatches. Rows are
+        flagged `block: true` because the amortized figure divides the
+        block's wall clock evenly, and under a pipeline depth > 1 adjacent
+        block spans overlap (block i+1 is in flight while block i drains),
+        so summing them can exceed wall time."""
+        rounds = list(rounds)
+        end = time.perf_counter()
+        s = Span(name, end - duration, end,
+                 meta={"rounds": [rounds[0], rounds[-1]], **meta}
+                 if rounds else dict(meta))
+        self.spans.append(s)
+        per_round = duration / max(len(rounds), 1)
+        for sink in self.sinks:
+            for r in rounds:
+                sink("span", {"name": name, "duration": per_round,
+                              "round": r, "block": True, **meta})
+
     def log(self, metrics: dict):
         self.metrics.append(metrics)
         for sink in self.sinks:

@@ -50,11 +50,22 @@ def _pair(backend: str, run_id: str):
         table = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
         a = FedCommManager(create_transport(
             backend, 0, run_id, ip_table=table, port=p0), 0)
-        b = FedCommManager(create_transport(
-            backend, 1, run_id, ip_table=table, port=p1), 1)
+        try:
+            b = FedCommManager(create_transport(
+                backend, 1, run_id, ip_table=table, port=p1), 1)
+        except BaseException:
+            # the retry loop in bench_backend would otherwise leak rank 0's
+            # already-bound server thread into every later backend of the
+            # same process (pytest runs them all in one)
+            a.stop()
+            raise
         return a, b
     a = FedCommManager(create_transport(backend, 0, run_id, **kw), 0)
-    b = FedCommManager(create_transport(backend, 1, run_id, **kw), 1)
+    try:
+        b = FedCommManager(create_transport(backend, 1, run_id, **kw), 1)
+    except BaseException:
+        a.stop()
+        raise
     return a, b
 
 

@@ -8,7 +8,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:  # newer jax exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover — jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.config import TrainArgs
