@@ -213,6 +213,91 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """Telemetry report for a tracked run (reference: the MLOps run page;
+    local-first: everything is already on disk). Reads the run's
+    events JSONL (utils/sinks.JsonlSink) and prints a text summary —
+    per-span durations, metric-row counts, and the end-of-run counters/
+    histograms snapshot that mlops.finish appended — plus pointers to the
+    Chrome-trace artifact when present."""
+    import os
+
+    path = args.events
+    if path is None:
+        d = args.log_dir
+        if not os.path.isdir(d):
+            print(f"no log dir {d!r}", file=sys.stderr)
+            return 1
+        names = sorted(n for n in os.listdir(d)
+                       if n.endswith(".events.jsonl")
+                       and (args.run is None or n.startswith(args.run)))
+        if not names:
+            print(f"no *.events.jsonl under {d!r}"
+                  + (f" matching {args.run!r}" if args.run else ""),
+                  file=sys.stderr)
+            return 1
+        # newest run wins when several match
+        path = max((os.path.join(d, n) for n in names), key=os.path.getmtime)
+
+    spans: dict = {}
+    n_metrics = n_sysperf = 0
+    report_row = None
+    with open(path) as f:
+        for line in f:
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("kind") == "span":
+                agg = spans.setdefault(row.get("name", "?"),
+                                       {"count": 0, "total_s": 0.0})
+                agg["count"] += 1
+                agg["total_s"] += float(row.get("duration", 0.0))
+            elif row.get("kind") == "metrics":
+                n_metrics += 1
+                if "sysperf" in row:
+                    n_sysperf += 1
+                if "report" in row:
+                    report_row = row["report"]
+
+    print(f"run events: {path}")
+    trace = path.replace(".events.jsonl", ".trace.json")
+    if os.path.exists(trace):
+        print(f"chrome trace: {trace}  (open at ui.perfetto.dev)")
+    print(f"metric rows: {n_metrics} ({n_sysperf} sysperf)")
+    if spans:
+        print("spans:")
+        width = max(len(n) for n in spans)
+        for name, agg in sorted(spans.items(),
+                                key=lambda kv: -kv[1]["total_s"]):
+            avg_ms = agg["total_s"] / agg["count"] * 1e3
+            print(f"  {name:<{width}}  count={agg['count']:<8d} "
+                  f"total={agg['total_s']:.3f}s  avg={avg_ms:.2f}ms")
+    if report_row:
+        counters = report_row.get("metrics", {}).get("counters", {})
+        if counters:
+            print("counters:")
+            for k in sorted(counters):
+                print(f"  {k} = {counters[k]}")
+        hists = report_row.get("metrics", {}).get("histograms", {})
+        if hists:
+            print("histograms:")
+            for k in sorted(hists):
+                h = hists[k]
+                print(f"  {k}  count={h.get('count')} "
+                      f"p50={h.get('p50')} p99={h.get('p99')} "
+                      f"max={h.get('max')}")
+        gauges = report_row.get("metrics", {}).get("gauges", {})
+        if gauges:
+            print("gauges:")
+            for k in sorted(gauges):
+                print(f"  {k} = {gauges[k]}")
+    else:
+        print("(no end-of-run metrics snapshot row — run finished without "
+              "mlops.finish, or predates the telemetry layer)")
+    return 0
+
+
 def cmd_diagnosis(args) -> int:
     """Connectivity / capability checks (reference:
     slave/client_diagnosis.py — MQTT + S3 probes before joining a run).
@@ -319,10 +404,19 @@ def main(argv=None) -> int:
     gp.add_argument("--list", action="store_true", help="list runs only")
     sub.add_parser("diagnosis",
                    help="transport/device connectivity checks")
+    rp = sub.add_parser("report",
+                        help="summarize a tracked run's telemetry "
+                             "(spans, counters, trace pointer)")
+    rp.add_argument("--events", default=None,
+                    help="path to a <run>.events.jsonl (overrides "
+                         "--log-dir/--run)")
+    rp.add_argument("--log-dir", default="./log")
+    rp.add_argument("--run", default=None, help="run-name prefix filter")
     args = p.parse_args(argv)
     return {"version": cmd_version, "env": cmd_env, "run": cmd_run,
             "bench": cmd_bench, "launch": cmd_launch, "build": cmd_build,
-            "logs": cmd_logs, "diagnosis": cmd_diagnosis}[args.cmd](args)
+            "logs": cmd_logs, "diagnosis": cmd_diagnosis,
+            "report": cmd_report}[args.cmd](args)
 
 
 if __name__ == "__main__":

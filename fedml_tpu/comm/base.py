@@ -1,11 +1,22 @@
 """Transport abstraction (reference:
 core/distributed/communication/base_com_manager.py:7-26 BaseCommunicationManager
 + observer.py:4 Observer). A transport moves encoded Message frames between
-integer-addressed processes; the comm manager on top owns dispatch."""
+integer-addressed processes; the comm manager on top owns dispatch.
+
+Telemetry (ISSUE 2): every transport funnels its wire traffic through
+`_encode_frame`/`_decode_frame`, which stamp the sender's trace context into
+the message headers and feed the process-wide instruments
+(utils/metrics.py): `comm.<backend>.bytes_sent/recv`, `.msgs_sent/recv`
+counters and `.serialize_s`/`.deserialize_s` histograms — the byte/latency
+accounting that distinguishes comm stacks (PAPERS.md, cross-silo backends
+study) and that VERDICT flagged as the unmeasured comm perf floor.
+"""
 from __future__ import annotations
 
 import abc
+import time
 
+from ..utils import metrics as _mx
 from .message import Message
 
 
@@ -21,6 +32,9 @@ class BaseTransport(abc.ABC):
     add_observer / remove_observer / handle_receive_message /
     stop_receive_message)"""
 
+    #: metric namespace — `comm.<backend_name>.*` (loopback/grpc/broker)
+    backend_name = "base"
+
     def __init__(self):
         self._observers: list[Observer] = []
 
@@ -33,6 +47,33 @@ class BaseTransport(abc.ABC):
     def _notify(self, msg: Message) -> None:
         for obs in list(self._observers):
             obs.receive_message(msg.type, msg)
+
+    # ------------------------------------------------- instrumented codec
+    def _encode_frame(self, msg: Message, stamp: bool = True) -> bytes:
+        """Stamp trace headers, serialize, count: the single choke point for
+        outbound bytes on every transport. stamp=False keeps the frame
+        byte-identical across a broadcast (the broker's content-addressed
+        blob plane dedups by hash — per-send trace headers would mint n
+        distinct blobs; the trace context rides the topic-plane key frame
+        there instead)."""
+        if stamp:
+            msg.stamp_trace()
+        t0 = time.perf_counter()
+        frame = msg.encode()
+        pre = f"comm.{self.backend_name}"
+        _mx.observe(f"{pre}.serialize_s", time.perf_counter() - t0)
+        _mx.inc(f"{pre}.bytes_sent", len(frame))
+        _mx.inc(f"{pre}.msgs_sent")
+        return frame
+
+    def _decode_frame(self, frame: bytes) -> Message:
+        t0 = time.perf_counter()
+        msg = Message.decode(frame)
+        pre = f"comm.{self.backend_name}"
+        _mx.observe(f"{pre}.deserialize_s", time.perf_counter() - t0)
+        _mx.inc(f"{pre}.bytes_recv", len(frame))
+        _mx.inc(f"{pre}.msgs_recv")
+        return msg
 
     @abc.abstractmethod
     def send_message(self, msg: Message) -> None: ...

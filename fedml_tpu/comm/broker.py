@@ -23,10 +23,12 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 import uuid
 from collections import defaultdict, deque
 from typing import Optional
 
+from ..utils import metrics as _mx
 from .base import BaseTransport
 from .message import Message
 
@@ -169,6 +171,8 @@ class BrokerTransport(BaseTransport):
     mqtt_s3_multi_clients_comm_manager.py:  topic fedml_<run>_<rank>, S3 for
     model params). Messages survive receiver downtime in the topic queue."""
 
+    backend_name = "broker"
+
     def __init__(self, rank: int, run_id: str = "default",
                  broker: Optional[InMemoryBroker] = None,
                  blob_threshold: int = 16 * 1024):
@@ -191,15 +195,31 @@ class BrokerTransport(BaseTransport):
         # one payload to n receivers hashes identically, so the content-
         # addressed plane stores ONE blob, refcounted n); below the
         # threshold the re-encode with the true receiver is cheap by
-        # definition
-        canonical = Message(msg.type, msg.sender_id, -1, msg.params).encode()
+        # definition. Byte/msg counters and serialize time ride the
+        # canonical encode (the frame that actually carries the payload).
+        # stamp=False: per-send trace headers inside the canonical frame
+        # would break the hash-identical-broadcast dedup — the trace
+        # context rides the topic-plane key frame below instead.
+        canonical = self._encode_frame(
+            Message(msg.type, msg.sender_id, -1, msg.params), stamp=False)
         if len(canonical) > self.blob_threshold:
             key = self.broker.put_blob(canonical)
-            frame = (_BLOB_KEY_PREFIX + key.encode()
-                     + b"|" + str(msg.receiver_id).encode())
+            from ..utils.events import current_trace
+
+            tid, sid = current_trace()
+            frame = _BLOB_KEY_PREFIX + "|".join(
+                (key, str(msg.receiver_id), tid or "", sid or "")).encode()
+            _mx.inc("comm.broker.blob_puts")
+            _mx.inc("comm.broker.bytes_sent", len(frame))  # topic-plane key
         else:
+            # true-receiver re-encode (trace headers stamped here — inline
+            # frames never reach the content-addressed plane); payload
+            # bytes already counted above
+            msg.stamp_trace()
             frame = msg.encode()
+        t0 = time.perf_counter()
         self.broker.publish(self._topic(msg.receiver_id), frame)
+        _mx.observe("comm.broker.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:
         # NOTE: no clear() here — a stop() issued before this thread is
@@ -207,17 +227,35 @@ class BrokerTransport(BaseTransport):
         # transport is done (build a new one to reconnect).
         topic = self._topic(self.rank)
         while not self._stop_event.is_set():
-            frame = self.broker.poll(topic, timeout=0.2)
+            # poll_s measures the DEQUEUE cost only: a non-blocking poll is
+            # timed (pure transport work on a non-empty queue — the
+            # store-and-forward backlog case); when the queue is empty the
+            # blocking wait runs untimed, so idle/inter-arrival gaps never
+            # pollute the histogram
+            t0 = time.perf_counter()
+            frame = self.broker.poll(topic, timeout=0)
+            if frame is not None:
+                _mx.observe("comm.broker.poll_s", time.perf_counter() - t0)
+            else:
+                frame = self.broker.poll(topic, timeout=0.2)
             if frame is None:
                 continue
             if frame.startswith(_BLOB_KEY_PREFIX):
-                key, _, receiver = (
-                    frame[len(_BLOB_KEY_PREFIX):].decode().partition("|"))
-                msg = Message.decode(self.broker.get_blob(key))
+                parts = frame[len(_BLOB_KEY_PREFIX):].decode().split("|")
+                key, receiver = parts[0], parts[1] if len(parts) > 1 else ""
+                msg = self._decode_frame(self.broker.get_blob(key))
                 msg.receiver_id = int(receiver) if receiver else self.rank
+                # re-attach the trace context the dedup-friendly canonical
+                # frame deliberately left out (it rode the key frame)
+                if len(parts) > 2 and parts[2]:
+                    from .message import ARG_PARENT_SPAN, ARG_TRACE_ID
+
+                    msg.params[ARG_TRACE_ID] = parts[2]
+                    if len(parts) > 3 and parts[3]:
+                        msg.params[ARG_PARENT_SPAN] = parts[3]
                 self._notify(msg)
                 continue
-            self._notify(Message.decode(frame))
+            self._notify(self._decode_frame(frame))
 
     def stop_receive_message(self) -> None:
         self._stop_event.set()

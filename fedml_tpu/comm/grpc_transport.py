@@ -15,11 +15,13 @@ from __future__ import annotations
 import csv
 import queue
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
 import grpc
 
+from ..utils import metrics as _mx
 from .base import BaseTransport
 from .message import Message
 
@@ -44,6 +46,8 @@ def load_ip_table(path: str) -> dict[int, str]:
 
 
 class GrpcTransport(BaseTransport):
+    backend_name = "grpc"
+
     def __init__(self, rank: int, ip_table: dict[int, str],
                  port: Optional[int] = None, max_workers: int = 4,
                  max_message_mb: int = 512):
@@ -93,7 +97,12 @@ class GrpcTransport(BaseTransport):
         )
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.receiver_id)(msg.encode())
+        frame = self._encode_frame(msg)
+        # publish latency here is the blocking unary RPC — wire + remote
+        # handler enqueue, the comm study's transport-level latency term
+        t0 = time.perf_counter()
+        self._stub(msg.receiver_id)(frame)
+        _mx.observe("comm.grpc.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -104,7 +113,7 @@ class GrpcTransport(BaseTransport):
                 continue
             if frame is None:
                 break
-            self._notify(Message.decode(frame))
+            self._notify(self._decode_frame(frame))
 
     def stop_receive_message(self) -> None:
         self.shutdown(grace=1.0)

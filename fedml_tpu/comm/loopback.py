@@ -9,8 +9,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import defaultdict
 
+from ..utils import metrics as _mx
 from .base import BaseTransport
 from .message import Message
 
@@ -48,6 +50,7 @@ def release_router(run_id: str) -> None:
 
 class LoopbackTransport(BaseTransport):
     _STOP = object()
+    backend_name = "loopback"
 
     def __init__(self, rank: int, run_id: str = "default"):
         super().__init__()
@@ -57,8 +60,10 @@ class LoopbackTransport(BaseTransport):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
-        frame = msg.encode()  # exercise the wire format even in-process
+        frame = self._encode_frame(msg)  # exercise the wire format in-process
+        t0 = time.perf_counter()
         self.router.mailbox(msg.receiver_id).put(frame)
+        _mx.observe("comm.loopback.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -66,7 +71,7 @@ class LoopbackTransport(BaseTransport):
             item = self._inbox.get()
             if item is self._STOP:
                 break
-            self._notify(Message.decode(item))
+            self._notify(self._decode_frame(item))
 
     def stop_receive_message(self) -> None:
         self._running = False
@@ -94,7 +99,5 @@ class JitterLoopbackTransport(LoopbackTransport):
         self.max_delay = max_delay
 
     def send_message(self, msg: Message) -> None:
-        import time
-
         time.sleep(self._rng.random() * self.max_delay)
         super().send_message(msg)

@@ -19,6 +19,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..utils import metrics as _mx
+from ..utils.events import recorder, trace_context
 from .base import BaseTransport, Observer
 from .loopback import LoopbackTransport
 from .message import Message
@@ -40,8 +42,13 @@ class FedCommManager(Observer):
 
     def send_message(self, msg: Message) -> None:  # :53
         # the Message's own sender_id is authoritative (callers construct it
-        # with their client id, which need not equal the transport rank)
-        self.transport.send_message(msg)
+        # with their client id, which need not equal the transport rank).
+        # The span puts a trace context on this thread; the transport's
+        # _encode_frame stamps it into the headers, so the receiver's
+        # handle span stitches to this one.
+        with recorder.span(f"comm.send.{msg.type}", sender=msg.sender_id,
+                           receiver=msg.receiver_id):
+            self.transport.send_message(msg)
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
         handler = self._handlers.get(msg_type)
@@ -50,7 +57,13 @@ class FedCommManager(Observer):
                 f"rank {self.rank}: no handler registered for {msg_type!r} "
                 f"(registered: {sorted(self._handlers)})"
             )
-        handler(msg)
+        tid, parent = msg.trace_context()
+        _mx.inc("comm.msgs_handled")
+        with trace_context(tid, parent):
+            with recorder.span(f"comm.handle.{msg_type}",
+                               sender=msg.sender_id,
+                               receiver=msg.receiver_id):
+                handler(msg)
 
     def run(self, background: bool = False) -> None:
         """Enter the receive loop (reference: run() :25 →

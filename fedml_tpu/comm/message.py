@@ -21,6 +21,14 @@ ARG_NUM_SAMPLES = "num_samples"
 ARG_CLIENT_STATUS = "client_status"
 ARG_ROUND = "round_idx"
 
+# trace-context headers (ISSUE 2): stamped by the sending transport from the
+# sender's active span, adopted by FedCommManager around handler dispatch —
+# a cross-silo send→receive→handle chain stitches into ONE trace. Underscore
+# prefix keeps them visually apart from payload keys; handlers read params
+# by key, so the extra entries are inert.
+ARG_TRACE_ID = "_trace_id"
+ARG_PARENT_SPAN = "_parent_span"
+
 
 @dataclasses.dataclass
 class Message:
@@ -39,6 +47,25 @@ class Message:
     # reference API names (message.py:40-70)
     add_params = add
     get_params = get
+
+    def stamp_trace(self) -> "Message":
+        """Copy the calling thread's active trace context into the message
+        headers. No-op when no span is open or the headers are already set
+        (a relay/forward keeps the originating trace)."""
+        from ..utils.events import current_trace
+
+        tid, sid = current_trace()
+        if tid and ARG_TRACE_ID not in self.params:
+            self.params[ARG_TRACE_ID] = tid
+            if sid:
+                self.params[ARG_PARENT_SPAN] = sid
+        return self
+
+    def trace_context(self) -> tuple:
+        """(trace_id, parent_span_id) from the headers; (None, None) for an
+        unstamped message."""
+        return (self.params.get(ARG_TRACE_ID),
+                self.params.get(ARG_PARENT_SPAN))
 
     def encode(self) -> bytes:
         return serialization.encode({

@@ -32,13 +32,56 @@ Pytree = Any
 
 
 def _normalize_var_path(name: str) -> str:
-    """Stable structural name for a framework variable: drop the ':0' tensor
-    suffix and the per-process numeric uniquifiers keras appends to layer
-    names ('sequential_1/dense_2/kernel' -> 'sequential/dense/kernel'), so
-    two silos that built a different number of models in their process still
-    agree on the name of the same architectural position."""
+    """Stable structural name for ONE framework variable: drop the ':0'
+    tensor suffix and any trailing `_<digits>` per path segment
+    ('sequential_1/dense_2/kernel' -> 'sequential/dense/kernel'). Use
+    `_normalize_var_paths` when the full variable list is available — it is
+    sibling-aware (see its docstring); this single-name form cannot tell a
+    keras process-global uniquifier from a deliberately numbered sibling
+    layer, so both strip."""
     name = name.split(":")[0]
     return "/".join(re.sub(r"_\d+$", "", s) for s in name.split("/"))
+
+
+def _normalize_var_paths(names: list[str]) -> list[str]:
+    """Stable structural names for a model's FULL ordered variable list.
+
+    Keras uniquifies layer names process-globally ('dense_2/kernel' in a
+    process that built models before), so raw names cannot ride the wire —
+    two silos with the same architecture would disagree. Stripping every
+    trailing `_<digits>` (the old behavior) fixes that but collapses
+    DELIBERATELY numbered sibling layers ('dense' and 'dense_1' in one
+    Sequential) onto one name, making different positions fingerprint
+    identically.
+
+    Sibling-aware scheme: per path segment, strip the `_<digits>` suffix to
+    a base name, then CANONICALLY renumber siblings that share a base under
+    the same parent by first-appearance order (first -> 'dense', second ->
+    'dense_1', ...). Variable order follows model structure, so two silos
+    that built any number of prior models still agree ('dense_7/dense_8'
+    and 'dense/dense_1' both normalize to 'dense'/'dense_1'), while true
+    siblings keep distinct names — the un-suffixed name is only claimed by
+    a sibling when it genuinely is one.
+
+    Remaining trade-off (accepted): a user-chosen name with a trailing
+    `_<digits>` and NO same-base sibling ('branch_2' alone) is
+    indistinguishable from a uniquifier and loses its suffix; siblings the
+    user numbered sparsely ('block_1'/'block_3') renumber densely
+    ('block'/'block_1'). Both are deterministic and consistent across
+    silos, so federation and fingerprinting stay correct."""
+    segs = [n.split(":")[0].split("/") for n in names]
+    # (segment position, raw parent path, base) -> {raw segment: ordinal}
+    ordinals: dict[tuple, dict[str, int]] = {}
+    out = []
+    for s in segs:
+        norm: list[str] = []
+        for i, seg in enumerate(s):
+            base = re.sub(r"_\d+$", "", seg)
+            slot = ordinals.setdefault((i, tuple(s[:i]), base), {})
+            k = slot.setdefault(seg, len(slot))
+            norm.append(base if k == 0 else f"{base}_{k}")
+        out.append("/".join(norm))
+    return out
 
 
 def arch_fingerprint(entries) -> tuple[str, str]:
@@ -174,9 +217,9 @@ class TFSiloTrainer:
         self.n_samples = int(self.x.shape[0])
         # build variables eagerly so get/set_params see the full set
         self.model(self.x[:1])
-        self._names = [
-            _normalize_var_path(str(getattr(v, "path", None) or v.name))
-            for v in self.model.variables]
+        self._names = _normalize_var_paths([
+            str(getattr(v, "path", None) or v.name)
+            for v in self.model.variables])
         self.arch_fp, self.arch_desc = arch_fingerprint(
             (n, tuple(v.shape), str(getattr(v.dtype, "name", v.dtype)))
             for n, v in zip(self._names, self.model.variables))

@@ -31,7 +31,8 @@ from .utils.events import recorder
 from .utils.sysperf import SysPerfMonitor
 
 _state: dict = {"sysperf": None, "log_handler": None, "events": {},
-                "sinks": [], "prev_root_level": None, "artifacts": None}
+                "sinks": [], "prev_root_level": None, "artifacts": None,
+                "trace_run": None}
 
 
 def init(cfg, sysperf_interval: Optional[float] = None) -> None:
@@ -60,6 +61,11 @@ def init(cfg, sysperf_interval: Optional[float] = None) -> None:
         interval = sysperf_interval if sysperf_interval is not None else \
             float(t.extra.get("sysperf_interval", 10.0))
         _state["sysperf"] = SysPerfMonitor(interval).start()
+    if t.enable_tracking:
+        # remembered for finish(): the Chrome-trace artifact lands next to
+        # the run's log/events files (ISSUE 2 — a tracked run produces an
+        # openable trace with zero user code)
+        _state["trace_run"] = (t.log_file_dir, t.run_name)
     # model-artifact store (reference: log_aggregated_model_info uploads to
     # S3; here tracking_args.extra picks the sink):
     #   artifact_store: "file" (default when artifact_dir set) | "broker"
@@ -172,9 +178,53 @@ def system_stats() -> dict:
     return sample_sysperf()
 
 
+def metrics_snapshot() -> dict:
+    """One dict of every process-wide counter/gauge/histogram (comm bytes &
+    latency, serving request histograms, XLA compile/retrace counts —
+    utils/metrics.py). The quantitative companion to `system_stats()`."""
+    from .utils import metrics
+
+    return metrics.snapshot()
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the recorder's spans as a Chrome-trace/Perfetto JSON
+    (utils/events.py EventRecorder.export_chrome_trace)."""
+    return recorder.export_chrome_trace(path)
+
+
+def _finish_report() -> None:
+    """End-of-run summary → sinks (the reference posts a run-summary row at
+    release), plus the Chrome-trace artifact for tracked runs."""
+    # gate on recorder.sinks, not _state["sinks"]: fedml_tpu.init attaches
+    # the config sinks itself, so this run's JsonlSink may predate mlops.init
+    if recorder.sinks:
+        try:
+            recorder.log({"report": {"spans": recorder.summary(),
+                                     "metrics": metrics_snapshot()}})
+        except Exception as e:  # noqa: BLE001 — a summary must not block exit
+            logging.getLogger(__name__).warning(
+                "end-of-run summary failed: %s: %s", type(e).__name__, e)
+    run = _state["trace_run"]
+    _state["trace_run"] = None
+    if run is not None:
+        try:
+            recorder.export_chrome_trace(
+                os.path.join(run[0], f"{run[1]}.trace.json"))
+        except Exception as e:  # noqa: BLE001
+            logging.getLogger(__name__).warning(
+                "chrome-trace export failed: %s: %s", type(e).__name__, e)
+
+
 def finish() -> None:
-    """Stop daemons, detach this run's sinks and log handler, restore the
-    root log level (reference: mlops release paths)."""
+    """Emit the end-of-run summary + Chrome trace, stop daemons, detach this
+    run's sinks and log handler, restore the root log level (reference:
+    mlops release paths)."""
+    from .utils.sinks import flush_sinks
+
+    run = _state["trace_run"]     # before _finish_report clears it
+    _finish_report()
+    flush_sinks()   # BrokerLogSink batches; the tail batch must ship
     if _state["sysperf"] is not None:
         _state["sysperf"].stop()
         _state["sysperf"] = None
@@ -183,6 +233,18 @@ def finish() -> None:
             recorder.sinks.remove(sink)
         getattr(sink, "close", lambda: None)()
     _state["sinks"].clear()
+    if run is not None:
+        # this run's sinks may have been attached by fedml_tpu.init BEFORE
+        # mlops.init (attach_from_config is idempotent, so _state["sinks"]
+        # never saw them); leaving them on the recorder would keep writing
+        # every later span to the finished run's (possibly deleted) file
+        log_dir, run_name = os.path.abspath(run[0]), run[1]
+        for sink in list(recorder.sinks):
+            key = getattr(sink, "_attach_key", None)
+            if (isinstance(key, tuple) and key and key[-1] == run_name
+                    and (len(key) != 2 or key[0] in (log_dir, "wandb"))):
+                recorder.sinks.remove(sink)
+                getattr(sink, "close", lambda: None)()
     root = logging.getLogger()
     if _state["log_handler"] is not None:
         root.removeHandler(_state["log_handler"])

@@ -37,6 +37,7 @@ except ImportError:  # pragma: no cover — jax <= 0.4.x
 
 from ..core.algorithm import FULL, ClientMetrics, FedAlgorithm, ServerState
 from ..ops import tree as tu
+from ..utils.metrics import track_jit
 
 Pytree = Any
 
@@ -272,8 +273,11 @@ def build_round_fn(
         postprocess_agg, num_real_clients,
     )
     # donate server/client/hook state: all three are dead after the call, and
-    # the hook state can be a [N, D] defense history that must update in place
-    return jax.jit(round_body, donate_argnums=(0, 1, 6))
+    # the hook state can be a [N, D] defense history that must update in place.
+    # track_jit keeps PR 1's retrace guard on as a metric: gauge
+    # xla.compiles.round_fn / counter xla.retraces.round_fn.
+    return track_jit(jax.jit(round_body, donate_argnums=(0, 1, 6)),
+                     "round_fn")
 
 
 def build_block_fn(
@@ -325,7 +329,8 @@ def build_block_fn(
 
     # same donation contract as the single-round program; the scan carry
     # aliases the donated buffers so K rounds update state in place
-    return jax.jit(block_body, donate_argnums=(0, 1, 7))
+    return track_jit(jax.jit(block_body, donate_argnums=(0, 1, 7)),
+                     "block_fn")
 
 
 def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:

@@ -11,9 +11,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..utils import metrics as _mx
+from ..utils.events import recorder
 from .predictor import Predictor
 
 log = logging.getLogger(__name__)
@@ -53,21 +56,40 @@ class FedMLInferenceRunner:
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
+                # queue depth = requests in flight on the threading server
+                # (each request holds a thread; the predictor serializes
+                # device work through jit, so depth > 1 means queueing)
+                t0 = time.perf_counter()
+                with runner._inflight_lock:
+                    runner._inflight += 1
+                    _mx.set_gauge("serving.queue_depth", runner._inflight)
+                _mx.inc("serving.requests")
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    input_json = json.loads(self.rfile.read(n) or b"{}")
-                    result = runner.predictor.predict(input_json)
-                    if not isinstance(result, dict):
-                        result = {"generated_text": str(result)}
-                    self._send(200, result)
+                    with recorder.span("serving.request", path=self.path):
+                        n = int(self.headers.get("Content-Length", 0))
+                        input_json = json.loads(self.rfile.read(n) or b"{}")
+                        result = runner.predictor.predict(input_json)
+                        if not isinstance(result, dict):
+                            result = {"generated_text": str(result)}
+                        self._send(200, result)
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     log.exception("predict failed")
+                    _mx.inc("serving.errors")
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    with runner._inflight_lock:
+                        runner._inflight -= 1
+                        _mx.set_gauge("serving.queue_depth",
+                                      runner._inflight)
+                    _mx.observe("serving.request_s",
+                                time.perf_counter() - t0)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]  # resolved when port=0
         self._thread: Optional[threading.Thread] = None
         self._serving = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     def run(self) -> None:
         log.info("serving on :%d (/predict, /ready)", self.port)

@@ -36,6 +36,9 @@ import urllib.request
 import uuid
 from typing import Any, Optional
 
+from ..utils import metrics as _mx
+from ..utils.events import recorder
+
 log = logging.getLogger(__name__)
 
 R_DISPATCHED = "DISPATCHED"
@@ -269,12 +272,16 @@ class InferenceGateway:
                 body = self.rfile.read(n)
                 with gateway._inflight_lock:
                     gateway.inflight += 1
+                    _mx.set_gauge("serving.gateway_inflight",
+                                  gateway.inflight)
                 try:
                     code, payload = gateway.forward(body)
                     self._send(code, payload)
                 finally:
                     with gateway._inflight_lock:
                         gateway.inflight -= 1
+                        _mx.set_gauge("serving.gateway_inflight",
+                                      gateway.inflight)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -285,6 +292,15 @@ class InferenceGateway:
     def forward(self, body: bytes, tries: int = 3) -> tuple[int, dict]:
         """Round-robin with failover: a replica that errors at the transport
         level is marked DEAD and the request retries elsewhere."""
+        t0 = time.perf_counter()
+        try:
+            with recorder.span("serving.forward"):
+                return self._forward(body, tries)
+        finally:
+            _mx.observe("serving.gateway_forward_s",
+                        time.perf_counter() - t0)
+
+    def _forward(self, body: bytes, tries: int) -> tuple[int, dict]:
         for _ in range(tries):
             rep = self.dep.pick()
             if rep is None:
@@ -305,6 +321,7 @@ class InferenceGateway:
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 log.warning("replica %s unreachable; rerouting",
                             rep.replica_id)
+                _mx.inc("serving.gateway_failovers")
                 self.dep.mark_dead(rep)
                 self.dep.reap_and_heal()
         return 502, {"error": "all replicas failed"}

@@ -206,9 +206,14 @@ class Simulator:
 
         # task-aware: segmentation evaluates through the whole-set
         # confusion-matrix evaluator so mIoU rides the eval row (FedSeg
-        # parity — the reference server evaluates mIoU every round)
-        self._eval = make_eval_fn(self.apply_fn, t.extra.get("task"),
-                                  self.num_classes)
+        # parity — the reference server evaluates mIoU every round).
+        # track_jit: eval retraces surface as xla.compiles/retraces.eval_fn
+        # like the round/block programs (ISSUE 2 always-on retrace metric)
+        from ..utils.metrics import track_jit
+
+        self._eval = track_jit(
+            make_eval_fn(self.apply_fn, t.extra.get("task"),
+                         self.num_classes), "eval_fn")
         self.history: list[dict] = []
 
     # reference parity: np seeded by round index (fedavg_api.py:127-135)

@@ -58,6 +58,15 @@ class SysPerfMonitor:
         if self._thread is not None:
             return self
         self._stop.clear()
+        # prime psutil's cpu_percent: the FIRST interval=None sample of a
+        # process always reports 0.0 (no prior reading to diff against),
+        # which would poison the opening sysperf rows of every run
+        try:
+            import psutil
+
+            psutil.cpu_percent(interval=None)
+        except Exception:  # pragma: no cover — psutil absent/hiccup
+            pass
 
         def loop():
             while not self._stop.wait(self.interval):

@@ -23,3 +23,28 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _isolate_recorder():
+    """Tests share the PROCESS-GLOBAL event recorder (utils/events.py);
+    snapshot its state (spans, metric rows, sinks, the exact-count summary
+    aggregate) before each test and restore it after, so one test's
+    telemetry can't satisfy — or pollute — another test's assertions.
+    Background daemons a test failed to stop may append during restore;
+    that's the same leak the fixture existed to contain, just one row of
+    it."""
+    from fedml_tpu.utils.events import recorder
+
+    spans, metrics = list(recorder.spans), list(recorder.metrics)
+    sinks = list(recorder.sinks)
+    agg = {k: dict(v) for k, v in recorder.summary().items()}
+    yield
+    recorder.spans.clear()
+    recorder.spans.extend(spans)
+    recorder.metrics.clear()
+    recorder.metrics.extend(metrics)
+    recorder.sinks[:] = sinks
+    with recorder._agg_lock:
+        recorder._agg.clear()
+        recorder._agg.update(agg)

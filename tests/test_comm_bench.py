@@ -16,8 +16,24 @@ from comm_bench import BACKENDS, bench_backend
 def test_comm_bench_smoke(backend):
     if backend == "grpc":
         pytest.importorskip("grpc")
-    row = bench_backend(backend, payload_mb=0.25, iters=5, warmup=1)
+    iters, warmup = 5, 1
+    row = bench_backend(backend, payload_mb=0.25, iters=iters, warmup=warmup)
     assert row["backend"] == backend
     assert row["rtt_ms_p50"] > 0
     assert row["throughput_mb_s"] > 0
     assert row["payload_mb"] == 0.25
+    # ISSUE 2: the comm-layer perf floor is a CHECKED artifact — every
+    # backend's counters must be non-zero and consistent with what the
+    # bench actually moved. Sends: warmup+iters echoes + 1 warm + >=3
+    # timed bulks (mirrors bench_backend's bulk loop); each bulk frame
+    # carries the 0.25MB payload.
+    n_bulk = 1 + max(3, iters // 5)
+    payload_bytes = int(0.25 * 2**20)
+    assert row["msgs_sent"] >= (warmup + iters) + n_bulk
+    assert row["bytes_sent"] >= n_bulk * payload_bytes
+    # the receive leg saw the same frames (echo replies ride the same
+    # process-wide counters, so recv >= the bulk payload floor too)
+    assert row["msgs_recv"] >= n_bulk
+    assert row["bytes_recv"] >= n_bulk * payload_bytes
+    assert row["publish_ms_p50"] is not None and row["publish_ms_p50"] > 0
+    assert row["publish_ms_p99"] >= row["publish_ms_p50"]

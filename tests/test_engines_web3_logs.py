@@ -452,3 +452,29 @@ def test_tf_legacy_index_only_keys_still_load():
     bad[k0] = bad[k0].T.copy()
     with pytest.raises(ValueError, match="shape mismatch"):
         tr.set_params(bad)
+
+
+def test_normalize_var_paths_sibling_aware():
+    """ADVICE.md last open item: keras uniquifier suffixes strip, but
+    DELIBERATELY numbered sibling layers keep distinct (canonically
+    renumbered) names — and two processes whose uniquifier counters differ
+    still agree on every name."""
+    from fedml_tpu.engines import _normalize_var_paths
+
+    # deliberate siblings in one model: distinct names survive
+    first = _normalize_var_paths(
+        ["dense/kernel", "dense/bias", "dense_1/kernel", "dense_1/bias"])
+    assert first == ["dense/kernel", "dense/bias",
+                     "dense_1/kernel", "dense_1/bias"]
+    # same model built later in a process that uniquified the names:
+    # canonical renumbering makes the two silos agree exactly
+    later = _normalize_var_paths(
+        ["dense_7/kernel", "dense_7/bias", "dense_8/kernel", "dense_8/bias"])
+    assert later == first
+    # a lone uniquifier (no same-base sibling) still strips, nested too
+    assert _normalize_var_paths(["sequential_1/dense_2/kernel:0"]) == \
+        ["sequential/dense/kernel"]
+    # sibling sets at different tree positions renumber independently
+    assert _normalize_var_paths(
+        ["a_3/dense_5/kernel", "a_3/dense_6/kernel", "b/dense_9/kernel"]) == \
+        ["a/dense/kernel", "a/dense_1/kernel", "b/dense/kernel"]
