@@ -327,6 +327,37 @@ def bench_workload1_mnist_lr() -> dict:
     except Exception as e:  # noqa: BLE001
         out["w1_telemetry_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # run-health overhead (ISSUE 3): the SAME w1 loop with the in-jit
+    # per-client health stats DISABLED, vs the default-on loop timed above.
+    # The health arrays ride the existing metrics transfer (no extra host
+    # sync), so the measured overhead must stay under the 2% telemetry
+    # budget.
+    try:
+        cfg_h = fedml_tpu.init(config={
+            "data_args": {"dataset": "mnist", "partition_method": "homo"},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 10, "client_num_per_round": 10,
+                "comm_round": 10, "epochs": 1, "batch_size": 10,
+                "learning_rate": 0.03,
+                "extra": {"health_stats": False},
+            },
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "sp"},
+        })
+        sim_h = Simulator(cfg_h)
+        sim_h.run_round(0)  # compile
+        t0 = time.perf_counter()
+        for r in range(1, n + 1):
+            sim_h.run_round(r)
+        dt_h = time.perf_counter() - t0
+        out["w1_health_overhead_pct"] = round(
+            max(dt / dt_h - 1.0, 0.0) * 100, 2)
+        out["w1_health_budget_pct"] = 2.0
+    except Exception as e:  # noqa: BLE001
+        out["w1_health_error"] = f"{type(e).__name__}: {e}"[:120]
+
     # round-block execution (ISSUE 1): this workload is where the host-
     # synchronous driver dominates (round program ≪ dispatch + device_get +
     # host scheduling), so K=8 blocks are the acceptance row — bar: ≥ 2×
@@ -905,6 +936,7 @@ _HEADLINE_KEYS = (
     # workloads 1 and 4 (+ ISSUE 2 telemetry-overhead row, budget <2%)
     "w1_mnist_lr_sp_rounds_per_sec", "w1_blocked_rounds_per_sec",
     "w1_blocked_speedup", "w1_telemetry_overhead_pct",
+    "w1_health_overhead_pct",
     "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",

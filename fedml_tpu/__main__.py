@@ -213,6 +213,25 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def _newest_events_file(log_dir: str, run) -> str:
+    """The newest `<run>.events.jsonl` under `log_dir` (optionally filtered
+    by run-name prefix) — shared by the `report` and `top` verbs."""
+    import os
+
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"no log dir {log_dir!r}")
+    names = sorted(n for n in os.listdir(log_dir)
+                   if n.endswith(".events.jsonl")
+                   and (run is None or n.startswith(run)))
+    if not names:
+        raise FileNotFoundError(
+            f"no *.events.jsonl under {log_dir!r}"
+            + (f" matching {run!r}" if run else ""))
+    # newest run wins when several match
+    return max((os.path.join(log_dir, n) for n in names),
+               key=os.path.getmtime)
+
+
 def cmd_report(args) -> int:
     """Telemetry report for a tracked run (reference: the MLOps run page;
     local-first: everything is already on disk). Reads the run's
@@ -224,20 +243,11 @@ def cmd_report(args) -> int:
 
     path = args.events
     if path is None:
-        d = args.log_dir
-        if not os.path.isdir(d):
-            print(f"no log dir {d!r}", file=sys.stderr)
+        try:
+            path = _newest_events_file(args.log_dir, args.run)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
             return 1
-        names = sorted(n for n in os.listdir(d)
-                       if n.endswith(".events.jsonl")
-                       and (args.run is None or n.startswith(args.run)))
-        if not names:
-            print(f"no *.events.jsonl under {d!r}"
-                  + (f" matching {args.run!r}" if args.run else ""),
-                  file=sys.stderr)
-            return 1
-        # newest run wins when several match
-        path = max((os.path.join(d, n) for n in names), key=os.path.getmtime)
 
     spans: dict = {}
     n_metrics = n_sysperf = 0
@@ -260,6 +270,13 @@ def cmd_report(args) -> int:
                 if "report" in row:
                     report_row = row["report"]
 
+    if not spans and n_metrics == 0:
+        # a run dir with an events file but zero telemetry rows used to fall
+        # through to an empty report — fail loudly instead (ISSUE 3)
+        print(f"no telemetry rows in {path} — the run wrote no spans or "
+              "metrics (did it crash before the first round, or run with "
+              "tracking disabled?)", file=sys.stderr)
+        return 1
     print(f"run events: {path}")
     trace = path.replace(".events.jsonl", ".trace.json")
     if os.path.exists(trace):
@@ -296,6 +313,192 @@ def cmd_report(args) -> int:
         print("(no end-of-run metrics snapshot row — run finished without "
               "mlops.finish, or predates the telemetry layer)")
     return 0
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _top_frame(snap: dict, source: str, prev: dict = None,
+               dt: float = None) -> str:
+    """One screen of run health from a parsed /metrics snapshot (sanitized
+    Prometheus names). `prev`+`dt` turn cumulative counters into live
+    rates."""
+    import time as _time
+
+    from .utils.prometheus import histogram_percentile
+
+    c, g, h = snap["counters"], snap["gauges"], snap["histograms"]
+
+    def rate(key):
+        if prev is None or not dt:
+            return None
+        return (c.get(key, 0) - prev["counters"].get(key, 0)) / dt
+
+    lines = [f"fedml_tpu top — {source}  "
+             f"({_time.strftime('%Y-%m-%d %H:%M:%S')})"]
+    rnd = g.get("fed_round")
+    row = [f"round {int(rnd)}" if rnd is not None else "round -",
+           f"rounds_total {int(c.get('fed_rounds_total', 0))}"]
+    rr = rate("fed_rounds_total")
+    if rr is not None:
+        row.append(f"rounds/s {rr:.2f}")
+    if "fed_health_round_s" in g:
+        row.append(f"last_round {g['fed_health_round_s'] * 1e3:.1f}ms")
+    if "fed_version" in g:
+        row.append(f"async_version {int(g['fed_version'])}")
+    lines.append("  ".join(row))
+
+    # ------------------------------------------------------------- health
+    lines.append(
+        "health: divergent_now {}  flags_total {}  straggler_rounds {}  "
+        "norm_median {:.4g}  cosine_min {:.3f}".format(
+            int(g.get("fed_health_divergent", 0)),
+            int(c.get("fed_health_flags_total", 0)),
+            int(c.get("fed_health_straggler_rounds_total", 0)),
+            g.get("fed_health_update_norm_median", float("nan")),
+            g.get("fed_health_cosine_min", float("nan"))))
+    flags = {k[len("fed_health_flags_c"):-len("_total")]: int(v)
+             for k, v in c.items()
+             if k.startswith("fed_health_flags_c") and k.endswith("_total")}
+    lines.append("flags: " + (" ".join(
+        f"c{cid}x{n}" for cid, n in sorted(
+            flags.items(), key=lambda kv: -kv[1])[:12]) or "none"))
+
+    # -------------------------------------------------------- participation
+    part = {k[len("fed_participation_c"):-len("_total")]: int(v)
+            for k, v in c.items()
+            if k.startswith("fed_participation_c") and k.endswith("_total")}
+    if part:
+        top = sorted(part.items(), key=lambda kv: (-kv[1], int(kv[0])))[:10]
+        lines.append(
+            f"participation: {len(part)} clients seen | top "
+            + " ".join(f"c{cid}:{n}" for cid, n in top))
+    else:
+        lines.append("participation: (none yet)")
+
+    # ------------------------------------------------------------ staleness
+    st = h.get("fed_staleness")
+    if st and st["count"]:
+        p50 = histogram_percentile(st["buckets"], 0.5)
+        p99 = histogram_percentile(st["buckets"], 0.99)
+        lines.append(
+            f"staleness: n={st['count']} mean={st['sum'] / st['count']:.2f} "
+            f"p50<={p50:g} p99<={p99:g}")
+
+    # ----------------------------------------------------------------- comm
+    backends = sorted({k.split("_")[1] for k in c
+                       if k.startswith("comm_") and "_bytes_" in k})
+    for b in backends:
+        tx = c.get(f"comm_{b}_bytes_sent_total", 0)
+        rx = c.get(f"comm_{b}_bytes_recv_total", 0)
+        seg = f"comm[{b}]: tx {_fmt_bytes(tx)}  rx {_fmt_bytes(rx)}"
+        txr = rate(f"comm_{b}_bytes_sent_total")
+        if txr is not None:
+            seg += f"  tx/s {_fmt_bytes(txr)}"
+        rxr = rate(f"comm_{b}_bytes_recv_total")
+        if rxr is not None:
+            seg += f"  rx/s {_fmt_bytes(rxr)}"
+        lines.append(seg)
+
+    # -------------------------------------------------------------- serving
+    if "serving_requests_total" in c:
+        seg = (f"serving: requests {int(c['serving_requests_total'])}  "
+               f"errors {int(c.get('serving_errors_total', 0))}  "
+               f"queue {int(g.get('serving_queue_depth', 0))}")
+        sh = h.get("serving_request_s")
+        if sh and sh["count"]:
+            p50 = histogram_percentile(sh["buckets"], 0.5)
+            if p50 is not None:
+                seg += f"  p50<={p50 * 1e3:.2f}ms"
+        lines.append(seg)
+
+    # ------------------------------------------------------------- retraces
+    retr = {k: int(v) for k, v in c.items() if k.startswith("xla_retraces_")}
+    if retr:
+        lines.append("xla retraces: " + " ".join(
+            f"{k[len('xla_retraces_'):-len('_total')]}:{v}"
+            for k, v in sorted(retr.items())))
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live one-screen run health (reference: the MLOps run dashboard;
+    local-first: scrape the run's /metrics endpoint — or read a finished
+    run's end-of-run snapshot from its events file)."""
+    import time as _time
+
+    from .utils.prometheus import parse_prometheus, render_prometheus
+
+    url = args.url
+    if url is None and args.port is not None:
+        url = f"http://127.0.0.1:{args.port}/metrics"
+    # the run-dir fallback reads a FINISHED run's static end-of-run
+    # snapshot — looping over it would render the same frame forever
+    once = args.once or url is None
+
+    def fetch() -> tuple[dict, str]:
+        if url:
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return parse_prometheus(r.read().decode()), url
+        # run-dir fallback: the end-of-run metrics snapshot that
+        # mlops.finish appended to the newest events file; rendering it
+        # through the same exposition + parser normalizes the names
+        path = _newest_events_file(args.log_dir, args.run)
+        report = None
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "report" in row:
+                    report = row["report"]
+        if report is None or "metrics" not in report:
+            raise ValueError(
+                f"{path} has no end-of-run metrics snapshot (run without "
+                "mlops.finish?) — use --url against a live run")
+        return parse_prometheus(
+            render_prometheus(report["metrics"])), path
+
+    prev, prev_t = None, None
+    frame = 0
+    misses = 0
+    try:
+        while True:
+            try:
+                snap, source = fetch()
+                misses = 0
+            except Exception as e:  # noqa: BLE001 — operator-facing CLI
+                # a failure before the first frame (or in one-shot mode) is
+                # a hard error; inside a live watch a transient scrape miss
+                # (brief GC pause, connection reset) just skips the frame —
+                # until several in a row say the endpoint is really gone
+                misses += 1
+                print(f"top: {type(e).__name__}: {e}", file=sys.stderr)
+                if frame == 0 or once or misses >= 5:
+                    return 1
+                _time.sleep(args.interval)
+                continue
+            now = _time.monotonic()
+            text = _top_frame(snap, source, prev,
+                              (now - prev_t) if prev_t is not None else None)
+            if not once and frame:
+                print("\x1b[2J\x1b[H", end="")  # clear screen between frames
+            print(text, flush=True)
+            frame += 1
+            if once or (args.frames and frame >= args.frames):
+                return 0
+            prev, prev_t = snap, now
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0        # ^C is the documented way to stop a live watch
 
 
 def cmd_diagnosis(args) -> int:
@@ -363,11 +566,36 @@ def cmd_diagnosis(args) -> int:
         if not np.array_equal(got["a"], x["a"]):
             raise ValueError("wire codec roundtrip mismatch")
 
+    def metrics_endpoint():
+        # the run-health export plane end-to-end: bind an ephemeral
+        # /metrics server, scrape it, and PARSE the exposition (the same
+        # parser `fedml_tpu top` uses) — proves the scrape surface a
+        # monitoring stack would attach to actually works on this host
+        import urllib.request
+
+        from .utils import metrics as mx
+        from .utils.prometheus import MetricsExporter, parse_prometheus
+
+        mx.inc("diagnosis.metrics_probe")
+        exp = MetricsExporter(port=0).start()
+        try:
+            with urllib.request.urlopen(exp.url, timeout=5) as r:
+                text = r.read().decode()
+            parsed = parse_prometheus(text)
+            if "diagnosis_metrics_probe_total" not in parsed["counters"]:
+                raise ValueError("probe counter missing from exposition")
+            return {"port": exp.port,
+                    "series": len(parsed["counters"])
+                    + len(parsed["gauges"]) + len(parsed["histograms"])}
+        finally:
+            exp.stop()
+
     check("jax", jax_devices)
     check("wire_codec", wire)
     check("loopback_transport", loopback)
     check("grpc_transport", grpc)
     check("native_lib", native)
+    check("metrics_endpoint", metrics_endpoint)
     required_ok = all(checks[k]["ok"] for k in
                       ("jax", "wire_codec", "loopback_transport"))
     print(json.dumps({"ok": required_ok, "checks": checks}, indent=2))
@@ -412,11 +640,28 @@ def main(argv=None) -> int:
                          "--log-dir/--run)")
     rp.add_argument("--log-dir", default="./log")
     rp.add_argument("--run", default=None, help="run-name prefix filter")
+    tp = sub.add_parser("top",
+                        help="live one-screen run health from a /metrics "
+                             "endpoint (or a finished run's events file)")
+    tp.add_argument("--url", default=None,
+                    help="…/metrics endpoint URL of a live run "
+                         "(common_args.extra.metrics_port)")
+    tp.add_argument("--port", type=int, default=None,
+                    help="shorthand for --url http://127.0.0.1:PORT/metrics")
+    tp.add_argument("--log-dir", default="./log",
+                    help="fallback: newest run's end-of-run snapshot here")
+    tp.add_argument("--run", default=None, help="run-name prefix filter")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    tp.add_argument("--frames", type=int, default=0,
+                    help="stop after N frames (0 = run until ^C)")
     args = p.parse_args(argv)
     return {"version": cmd_version, "env": cmd_env, "run": cmd_run,
             "bench": cmd_bench, "launch": cmd_launch, "build": cmd_build,
             "logs": cmd_logs, "diagnosis": cmd_diagnosis,
-            "report": cmd_report}[args.cmd](args)
+            "report": cmd_report, "top": cmd_top}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -54,6 +54,10 @@ class CentralizedTrainer:
         # before the first trace: repeated runs reuse on-disk compiled
         # programs when common_args.extra.compilation_cache_dir is set
         maybe_enable_compilation_cache(cfg)
+        # opt-in live /metrics endpoint (common_args.extra.metrics_port)
+        from ..utils.prometheus import maybe_start_metrics_server
+
+        self.metrics_exporter = maybe_start_metrics_server(cfg)
         self.dataset = dataset if dataset is not None else data_loader.load(cfg)
         self.model = model if model is not None else model_hub.create(
             cfg.model_args.model, self.dataset.num_classes,
@@ -105,9 +109,12 @@ class CentralizedTrainer:
     def run(self, epochs: Optional[int] = None) -> list[dict]:
         t = self.cfg.train_args
         n_epochs = epochs if epochs is not None else t.epochs
+        from ..utils import metrics as _mx
+
         for e in range(n_epochs):
             rng = jax.random.fold_in(
                 jax.random.key(self.cfg.common_args.random_seed), e)
+            _mx.set_gauge("fed.epoch", float(e))
             with recorder.span("centralized_epoch", epoch=e):
                 self.params, self.opt_state, (lsum, correct, cnt) = \
                     self._train(self.params, self.opt_state, rng)

@@ -280,6 +280,22 @@ class Config:
                 raise ValueError(
                     f"train_args.{knob} must be an integer >= {lo}; "
                     f"got {val!r}")
+        # run-health export plane (utils/prometheus.py): /metrics endpoint
+        # port. Validated at load so a typo'd YAML fails before a run
+        # silently comes up unscrapeable.
+        mp = self.common_args.extra.get("metrics_port")
+        if mp is not None:
+            try:
+                # bool is an int subtype: `metrics_port: true` would
+                # otherwise pass as port 1 and fail only at bind time
+                ok = (not isinstance(mp, bool)
+                      and int(mp) == float(mp) and 0 <= int(mp) <= 65535)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    "common_args.extra.metrics_port must be an integer in "
+                    f"[0, 65535] (0 = ephemeral); got {mp!r}")
         if self.common_args.training_type not in (
             TRAINING_TYPE_SIMULATION,
             TRAINING_TYPE_CROSS_SILO,

@@ -187,6 +187,16 @@ def metrics_snapshot() -> dict:
     return metrics.snapshot()
 
 
+def prometheus_text() -> str:
+    """The current metrics snapshot as Prometheus text exposition — what
+    the /metrics endpoint (utils/prometheus.py, opt-in via
+    common_args.extra.metrics_port) serves to scrapers and `fedml_tpu
+    top`."""
+    from .utils.prometheus import render_prometheus
+
+    return render_prometheus()
+
+
 def export_chrome_trace(path: str) -> str:
     """Write the recorder's spans as a Chrome-trace/Perfetto JSON
     (utils/events.py EventRecorder.export_chrome_trace)."""

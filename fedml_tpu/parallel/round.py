@@ -61,6 +61,48 @@ class RoundOutput(NamedTuple):
     hook_state: Pytree = None      # defense/plugin state threaded across rounds
 
 
+def _tree_vdot(a: Pytree, b: Pytree) -> jax.Array:
+    """f32 dot product over matching pytrees (bf16 updates upcast so norms
+    don't saturate)."""
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+               for x, y in zip(leaves_a, leaves_b))
+
+
+def _client_health(upds: Pytree, agg: Pytree, loss_per_client: jax.Array,
+                   summed_metrics) -> dict:
+    """Per-client run-health stats (ISSUE 3 tentpole), computed IN-JIT so
+    they ride the round's existing metrics transfer — zero extra host syncs:
+
+      update_norm  — L2 norm of each client's update,
+      cosine       — cosine similarity of each update to the aggregate
+                     (the pre-postprocess aggregate: the raw consensus,
+                     before DP noise / defense post-processing perturb it),
+      loss_delta   — each client's mean training loss minus the cohort's
+                     weighted mean loss this round.
+
+    `upds` is the stacked [m, ...] update pytree, `agg` the aggregated
+    update, `loss_per_client` the [m] per-client mean loss (0 for zero-
+    weight mesh-padding duplicates — run_clients already zeroed their
+    metrics), `summed_metrics` the cohort-summed ClientMetrics.
+    """
+    norms = jax.vmap(lambda u: jnp.sqrt(jnp.maximum(_tree_vdot(u, u), 0.0)))(
+        upds)
+    dots = jax.vmap(lambda u: _tree_vdot(u, agg))(upds)
+    agg_norm = jnp.sqrt(jnp.maximum(_tree_vdot(agg, agg), 0.0))
+    cosine = dots / jnp.maximum(norms * agg_norm, 1e-12)
+    cohort = (summed_metrics.loss_sum.astype(jnp.float32)
+              / jnp.maximum(summed_metrics.count, 1.0))
+    return {"update_norm": norms, "cosine": cosine,
+            "loss_delta": loss_per_client - cohort}
+
+
+def _per_client_loss(mets) -> jax.Array:
+    """[m] mean training loss per client from stacked ClientMetrics."""
+    return (mets.loss_sum.astype(jnp.float32)
+            / jnp.maximum(mets.count, 1.0))
+
+
 def _make_round_body(
     alg: FedAlgorithm,
     mesh: Optional[Mesh] = None,
@@ -70,6 +112,7 @@ def _make_round_body(
     postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
+    health_stats: bool = False,
 ) -> Callable:
     """Build the traceable round body shared by `build_round_fn` (one round
     per jit call) and `build_block_fn` (K rounds scanned inside one jit).
@@ -100,6 +143,12 @@ def _make_round_body(
     unweighted statistics (krum distances, medians, foolsgold history) would
     be silently biased by them; the engine slices U/weights/ids back to the
     real prefix before invoking the hook.
+    health_stats: when True the round's metrics dict carries a "health"
+    sub-dict of per-client [m] f32 arrays (update_norm / cosine /
+    loss_delta — see `_client_health`) computed inside the program, riding
+    the same device→host transfer as the scalar metrics. Mesh-padding
+    duplicate rows are included (the host masks them by weight). Health
+    stats are observation-only: they change no training output.
     """
     use_full = aggregate_full is not None or alg.agg_mode == FULL
     if use_full and aggregate_full is None:
@@ -145,7 +194,8 @@ def _make_round_body(
             jax.tree.map(ungroup, mets),
         )
 
-    def finalize(server_state, agg, mets: ClientMetrics, new_states_full, hook_state):
+    def finalize(server_state, agg, mets: ClientMetrics, new_states_full,
+                 hook_state, health=None):
         new_server = alg.server_update(server_state, agg)
         n = jnp.maximum(mets.count, 1.0)
         metrics = {
@@ -153,6 +203,8 @@ def _make_round_body(
             "train_acc": mets.correct / n,
             "n_samples": mets.count,
         }
+        if health:
+            metrics["health"] = health
         return RoundOutput(new_server, new_states_full, metrics, hook_state)
 
     def round_body(server_state, full_cstates, data, ids, weights, rng, hook_state):
@@ -183,6 +235,7 @@ def _make_round_body(
                 cx = ctx
             return aggregate_full(upds, w, cx)
 
+        health = None
         if mesh is None:
             upds, nstates, mets = run_clients(bcast, shards, cstates, rngs, weights)
             if use_full:
@@ -190,6 +243,9 @@ def _make_round_body(
             else:
                 agg = tu.tree_weighted_mean(upds, weights)
             summed = jax.tree.map(lambda a: a.sum(0), mets)
+            if health_stats:
+                health = _client_health(upds, agg, _per_client_loss(mets),
+                                        summed)
         elif use_full:
             spec_c, spec_r = P(axis), P()
 
@@ -197,21 +253,30 @@ def _make_round_body(
                 shard_map,
                 mesh=mesh,
                 in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
-                out_specs=(spec_c, spec_c, spec_r),
+                out_specs=(spec_c, spec_c, spec_r, spec_c),
             )
             def block_full(bc, sh, cs, rg, w):
                 bc = _localize(bc, axis)
                 upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
                 summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
-                return upds, nstates, summed
+                # per-client mean loss leaves the shard_map client-sharded
+                # so the health stats can join it with the jit-level
+                # aggregate; an empty dict when health is off (out_specs
+                # are a pytree prefix, so {} matches spec_c trivially)
+                loss_c = ({"loss": _per_client_loss(mets)}
+                          if health_stats else {})
+                return upds, nstates, summed, loss_c
 
             # stacked updates come back client-sharded; the defense/attack
             # pipeline runs at the jit level, where GSPMD inserts whatever
             # collectives its ops need (gram matmuls for pairwise distances
             # ride the ICI all-gather) — no manual all_gather, and the result
             # is provably replicated for the server update.
-            upds, nstates, summed = block_full(bcast, shards, cstates, rngs, weights)
+            upds, nstates, summed, loss_c = block_full(
+                bcast, shards, cstates, rngs, weights)
             agg, hook_state = call_full(upds, weights)
+            if health_stats:
+                health = _client_health(upds, agg, loss_c["loss"], summed)
         else:
             spec_c, spec_r = P(axis), P()
 
@@ -219,7 +284,7 @@ def _make_round_body(
                 shard_map,
                 mesh=mesh,
                 in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
-                out_specs=(spec_r, spec_c, spec_r),
+                out_specs=(spec_r, spec_c, spec_r, spec_c),
             )
             def block(bc, sh, cs, rg, w):
                 # Mark the replicated broadcast as device-varying before any
@@ -241,9 +306,17 @@ def _make_round_body(
                 den = jax.lax.psum(jnp.sum(w), axis)
                 agg = jax.tree.map(lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
                 summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
-                return agg, nstates, summed
+                # the stacked updates never leave the shard_map in LINEAR
+                # mode, so the per-client health stats are computed HERE,
+                # where updates, the replicated aggregate, and the psum'd
+                # cohort metrics all coexist; they exit client-sharded
+                h = (_client_health(upds, agg, _per_client_loss(mets),
+                                    summed) if health_stats else {})
+                return agg, nstates, summed, h
 
-            agg, nstates, summed = block(bcast, shards, cstates, rngs, weights)
+            agg, nstates, summed, health = block(
+                bcast, shards, cstates, rngs, weights)
+            health = health or None
 
         if postprocess_agg is not None:
             agg = postprocess_agg(agg, ctx)
@@ -251,7 +324,8 @@ def _make_round_body(
             full_cstates = jax.tree.map(
                 lambda full, new: full.at[ids].set(new), full_cstates, nstates
             )
-        return finalize(server_state, agg, summed, full_cstates, hook_state)
+        return finalize(server_state, agg, summed, full_cstates, hook_state,
+                        health)
 
     return round_body
 
@@ -265,12 +339,13 @@ def build_round_fn(
     postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
+    health_stats: bool = False,
 ) -> Callable:
     """Build the jitted single-round function (see `_make_round_body` for the
     argument contract)."""
     round_body = _make_round_body(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
-        postprocess_agg, num_real_clients,
+        postprocess_agg, num_real_clients, health_stats,
     )
     # donate server/client/hook state: all three are dead after the call, and
     # the hook state can be a [N, D] defense history that must update in place.
@@ -289,6 +364,7 @@ def build_block_fn(
     postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
+    health_stats: bool = False,
 ) -> Callable:
     """Build the jitted ROUND-BLOCK function: K federated rounds as one XLA
     program, `lax.scan` over the exact same round body `build_round_fn` jits.
@@ -310,7 +386,7 @@ def build_block_fn(
     """
     round_body = _make_round_body(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
-        postprocess_agg, num_real_clients,
+        postprocess_agg, num_real_clients, health_stats,
     )
 
     def block_body(server_state, full_cstates, data, ids, weights, base_rng,

@@ -49,6 +49,12 @@ class FedMLInferenceRunner:
             def do_GET(self):
                 if self.path == "/ready":
                     self._send(200, {"status": "Success"})
+                elif self.path == "/metrics":
+                    # replicas expose the process registry (request latency,
+                    # queue depth, compile-vs-serve) in Prometheus text
+                    from ..utils.prometheus import write_metrics_response
+
+                    write_metrics_response(self)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

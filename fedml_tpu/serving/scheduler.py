@@ -261,6 +261,12 @@ class InferenceGateway:
                     n = len(gateway.dep.ready_replicas())
                     self._send(200 if n else 503,
                                {"ready_replicas": n})
+                elif self.path == "/metrics":
+                    # the gateway is the serving tier's scrape point:
+                    # inflight/forward/failover gauges + the whole registry
+                    from ..utils.prometheus import write_metrics_response
+
+                    write_metrics_response(self)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -34,7 +34,9 @@ from ..core.algorithm import (
 from ..data.fed_dataset import FedDataset
 from ..data import loader as data_loader
 from ..models import hub as model_hub
+from ..utils import metrics as _mx
 from ..utils.events import recorder
+from ..utils.health import record_participation, record_staleness
 from .simulator import _pad_test_batches
 
 
@@ -76,6 +78,11 @@ class AsyncSimulator:
         self.staleness_mode = str(t.extra.get("async_staleness", "polynomial"))
         self.poly_a = float(t.extra.get("async_poly_a", 0.5))
         spread = float(t.extra.get("async_speed_spread", 1.0))
+        # live scrape surface (common_args.extra.metrics_port) — the async
+        # loop's staleness/participation instruments feed `fedml_tpu top`
+        from ..utils.prometheus import maybe_start_metrics_server
+
+        self.metrics_exporter = maybe_start_metrics_server(cfg)
         rs = np.random.RandomState(cfg.common_args.random_seed)
         # per-client wall-clock per unit of work (lognormal heterogeneity)
         self.client_time = rs.lognormal(0.0, spread, self.dataset.num_clients)
@@ -164,6 +171,14 @@ class AsyncSimulator:
                 self.params = self._merge(self.params, client_p, a_eff)
                 self.version += 1
                 merged += 1
+                # run-health accounting (ISSUE 3): every merged update's
+                # staleness was previously written into history rows only;
+                # now it also lands in the fed.staleness histogram, and the
+                # merging client's participation counter bumps — the inputs
+                # `fedml_tpu top` and the health flags read
+                record_staleness(tau)
+                record_participation(cid)
+                _mx.set_gauge("fed.version", float(self.version))
                 if merged % eval_every == 0 or merged == total:
                     row = {
                         "update": merged, "sim_time": finish, "staleness": tau,

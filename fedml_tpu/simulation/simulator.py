@@ -150,6 +150,20 @@ class Simulator:
 
         self._schedule = bool(t.extra.get("heterogeneity_schedule", True))
         group = int(t.extra.get("clients_per_device_parallel", 1))
+        # run-health plane (ISSUE 3): per-client health stats ride the round
+        # program's existing metrics transfer (default on — measured under
+        # the telemetry budget; train_args.extra.health_stats=False opts
+        # out of the IN-JIT stats only). The tracker itself is always on:
+        # participation, round gauges, and straggler detection need no
+        # device outputs, and observe_round accepts health=None.
+        self._health_enabled = bool(t.extra.get("health_stats", True))
+        from ..utils.health import HealthTracker
+
+        self.health = HealthTracker.from_config(cfg)
+        # opt-in live scrape surface (common_args.extra.metrics_port)
+        from ..utils.prometheus import maybe_start_metrics_server
+
+        self.metrics_exporter = maybe_start_metrics_server(cfg)
         # one kwargs dict drives BOTH engines: the per-round program and the
         # K-round scanned block program trace the identical round body
         self._round_kwargs = dict(
@@ -157,6 +171,7 @@ class Simulator:
             aggregate_full=agg_full, postprocess_update=post_update,
             postprocess_agg=post_agg,
             num_real_clients=t.client_num_per_round,
+            health_stats=self._health_enabled,
         )
         self.round_fn = build_round_fn(self.alg, **self._round_kwargs)
         self.block_fn = None   # built lazily on the first blocked dispatch
@@ -294,15 +309,22 @@ class Simulator:
         rng = jax.random.fold_in(
             jax.random.key(self.cfg.common_args.random_seed), round_idx
         )
+        t0 = time.perf_counter()
         with recorder.span("train", round=round_idx):
             out = self.round_fn(
                 self.server_state, self.client_states, self.data,
                 jnp.asarray(ids), jnp.asarray(weights), rng, self.hook_state,
             )
-            metrics = jax.tree.map(float, jax.device_get(out.metrics))
+            fetched = jax.device_get(out.metrics)
+        # the per-client health arrays rode the SAME transfer as the scalar
+        # metrics; peel them off before the history row is float-mapped
+        health = fetched.pop("health", None)
+        metrics = jax.tree.map(float, fetched)
         self.server_state = out.server_state
         self.client_states = out.client_states
         self.hook_state = out.hook_state
+        self.health.observe_round(round_idx, ids, weights, health,
+                                  duration_s=time.perf_counter() - t0)
         self.dp.step_round()
         if self.dp.enabled and self.dp.accountant is not None:
             metrics["dp_epsilon"] = self.dp.get_epsilon()
@@ -427,7 +449,7 @@ class Simulator:
 
         snap = (jax.tree.map(jnp.copy, out.server_state.params)
                 if mlops.artifact_store() is not None else None)
-        return (blk, out.metrics, eval_out, snap, t0)
+        return (blk, ids, weights, out.metrics, eval_out, snap, t0)
 
     def _drain_block(self, pending) -> None:
         """Materialize one dispatched block: ONE host transfer for the
@@ -437,12 +459,23 @@ class Simulator:
         "train" span covers dispatch→materialization — the async dispatch
         returns in microseconds, so timing the dispatch alone would report
         near-zero per-round durations to the sinks."""
-        blk, metrics, eval_out, snap, t0 = pending
+        blk, ids, weights, metrics, eval_out, snap, t0 = pending
         m = jax.device_get(metrics)
-        recorder.log_block_span("train", blk, time.perf_counter() - t0)
+        block_s = time.perf_counter() - t0
+        # stacked [K, m] health arrays rode the block's single transfer;
+        # peel them off before the scalar rows are built, then feed the
+        # tracker one round at a time (same cadence as per-round mode, with
+        # the block's wall time amortized for straggler detection)
+        health = m.pop("health", None)
+        recorder.log_block_span("train", blk, block_s)
         for j, r in enumerate(blk):
             row = {"round": r}
             row.update({k: float(v[j]) for k, v in m.items()})
+            h_j = ({k: v[j] for k, v in health.items()}
+                   if health is not None else None)
+            self.health.observe_round(
+                r, ids[j], weights[j], h_j,
+                duration_s=block_s / max(len(blk), 1))
             self.dp.step_round()
             if self.dp.enabled and self.dp.accountant is not None:
                 row["dp_epsilon"] = self.dp.get_epsilon()

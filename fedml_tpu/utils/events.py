@@ -34,7 +34,25 @@ from typing import Callable, Optional
 
 logger = logging.getLogger("fedml_tpu")
 
-DEFAULT_EVENTS_CAP = int(os.environ.get("FEDML_TPU_EVENTS_CAP", 100_000))
+DEFAULT_EVENTS_CAP = 100_000
+
+
+def _events_cap() -> int:
+    """Resolve the ring-buffer cap at RECORDER CONSTRUCTION, not import:
+    `FEDML_TPU_EVENTS_CAP` set after this module is imported (tests,
+    notebooks) must still take effect on the next EventRecorder()."""
+    raw = os.environ.get("FEDML_TPU_EVENTS_CAP")
+    if raw is None:
+        return DEFAULT_EVENTS_CAP
+    try:
+        cap = int(raw)
+        if cap < 1:
+            raise ValueError(cap)
+        return cap
+    except ValueError:
+        logger.warning("ignoring FEDML_TPU_EVENTS_CAP=%r (not a positive "
+                       "integer); using %d", raw, DEFAULT_EVENTS_CAP)
+        return DEFAULT_EVENTS_CAP
 
 # jax.profiler's TraceAnnotation is resolved ONCE and cached (the hot path
 # used to try/except-import it inside every span() call). Resolution is
@@ -119,7 +137,9 @@ class EventRecorder:
     aggregate behind `summary()` stays exact regardless of eviction.
     """
 
-    def __init__(self, max_rows: int = DEFAULT_EVENTS_CAP):
+    def __init__(self, max_rows: Optional[int] = None):
+        if max_rows is None:
+            max_rows = _events_cap()
         self.spans: _Ring = _Ring(maxlen=max_rows)
         self.metrics: _Ring = _Ring(maxlen=max_rows)
         self.sinks: list[Callable[[str, dict], None]] = []

@@ -1,0 +1,204 @@
+"""Run-health analysis plane — anomaly flags from in-jit client stats.
+
+The round engine (parallel/round.py, health_stats=True) ships per-client
+update L2 norms, cosine-to-aggregate, and loss deltas with every round's
+metrics — at zero extra host syncs. This module is the HOST side: it turns
+those arrays into operator-facing signals, the heterogeneity/byzantine
+surface FedJAX exposes as built-in per-client metrics and FedML Parrot
+schedules around (PAPERS.md):
+
+- **Anomaly flags** — a rolling ROBUST z-score (median/MAD over a window of
+  recent rounds' cohort values; MAD is scaled by 1.4826 so the z is
+  stddev-comparable on Gaussian data) over client update norms and cosine
+  similarity. A client whose norm z-score exceeds `mad_threshold` (either
+  tail — both exploding and vanishing updates are anomalies) or whose
+  cosine z-score falls below `-mad_threshold` (pointing away from the
+  consensus: byzantine-suspect) is flagged. Nothing is flagged during the
+  first `warmup_rounds` rounds — the window is still filling and early-
+  training dynamics (large first-round norms) would false-positive.
+- **Participation accounting** — a per-client `fed.participation.c<id>`
+  counter bumps for every real (non-padding) appearance in a cohort, in
+  both the sync and async simulators.
+- **Staleness accounting** — the async simulator records every merged
+  update's staleness into the `fed.staleness` histogram.
+- **Straggler detection** — the same rolling median/MAD test over round
+  dispatch wall-times (per-round in the per-round driver, block-amortized
+  in blocked mode); a round beyond the threshold bumps
+  `fed.health.straggler_rounds`.
+
+Flags surface three ways so they reach every pane the repo already has:
+counters/gauges (`fed.health.*` — scraped by the /metrics endpoint and
+`fedml_tpu top`), a structured metrics row through the EventRecorder sinks
+(lands in `<run>.events.jsonl` and the `report` CLI), and a zero-duration
+`health.flag` span (lands on the Chrome trace's track alongside the round
+spans it annotates).
+
+No reference equivalent: the reference's MLOps plane reports sys-perf and
+round metrics but has no per-client divergence/straggler analysis.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from . import metrics as mx
+from .events import recorder as _default_recorder
+
+# MAD -> sigma for a normal distribution; makes mad_threshold comparable to
+# an ordinary z-score threshold (3.5 is the textbook robust-outlier cut).
+MAD_SCALE = 1.4826
+
+# staleness is measured in merge-version counts, not seconds
+STALENESS_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128)
+
+
+def record_participation(client_id: int) -> None:
+    """One real cohort appearance (or async merge) for `client_id`.
+
+    Cardinality note: this mints one counter per client id — right for the
+    simulators' 10s-100s of clients that `top` tabulates, but a deliberate
+    trade-off: a cross-device federation with 10k+ clients should aggregate
+    before export rather than scrape O(clients) series."""
+    mx.inc(f"fed.participation.c{int(client_id)}")
+
+
+def record_staleness(tau: float) -> None:
+    """One async update merged at staleness `tau` (server versions elapsed
+    between snapshot and merge)."""
+    mx.histogram("fed.staleness", STALENESS_BUCKETS).observe(float(tau))
+
+
+def robust_z(values: np.ndarray, pool: np.ndarray) -> np.ndarray:
+    """Robust z-scores of `values` against the pooled sample: (x - median) /
+    (MAD * 1.4826). A degenerate pool (MAD ~ 0, e.g. identical synthetic
+    shards) yields all-zero scores instead of exploding — no spurious flags
+    from numerically-identical cohorts."""
+    pool = np.asarray(pool, np.float64)
+    values = np.asarray(values, np.float64)
+    if pool.size == 0:
+        return np.zeros_like(values)
+    med = float(np.median(pool))
+    mad = float(np.median(np.abs(pool - med))) * MAD_SCALE
+    if mad <= 1e-12 * max(1.0, abs(med)):
+        return np.zeros_like(values)
+    return (values - med) / mad
+
+
+class HealthTracker:
+    """Rolling per-run health analysis (one instance per simulator run).
+
+    observe_round() is the single entry point: feed it each round's sampled
+    ids/weights, the in-jit health arrays (or None when health stats are
+    off — participation/straggler accounting still runs), and the round's
+    dispatch wall time. Returns the round's flag record (also emitted to
+    metrics + recorder), so callers and tests can assert on it directly.
+    """
+
+    def __init__(self, mad_threshold: float = 3.5, warmup_rounds: int = 3,
+                 window: int = 20, recorder=None):
+        if mad_threshold <= 0 or warmup_rounds < 0 or window < 1:
+            raise ValueError(
+                f"invalid health knobs: mad_threshold={mad_threshold!r} "
+                f"(> 0), warmup_rounds={warmup_rounds!r} (>= 0), "
+                f"window={window!r} (>= 1)")
+        self.mad_threshold = float(mad_threshold)
+        self.warmup_rounds = int(warmup_rounds)
+        self._rec = recorder if recorder is not None else _default_recorder
+        self._norms: deque = deque(maxlen=int(window))
+        self._cosines: deque = deque(maxlen=int(window))
+        self._durations: deque = deque(maxlen=int(window))
+        self.rounds_seen = 0
+        # client_id -> total flag count, for top/report summaries
+        self.flag_counts: dict[int, int] = {}
+
+    @classmethod
+    def from_config(cls, cfg) -> "HealthTracker":
+        """Knobs ride train_args.extra: health_mad_threshold (3.5),
+        health_warmup_rounds (3), health_window (20)."""
+        x = cfg.train_args.extra
+        return cls(
+            mad_threshold=float(x.get("health_mad_threshold", 3.5)),
+            warmup_rounds=int(x.get("health_warmup_rounds", 3)),
+            window=int(x.get("health_window", 20)),
+        )
+
+    # ------------------------------------------------------------ analysis
+    def _flag_clients(self, ids, norms, cosines) -> list[dict]:
+        pool_n = np.concatenate(list(self._norms) + [norms])
+        pool_c = np.concatenate(list(self._cosines) + [cosines])
+        zn = robust_z(norms, pool_n)
+        zc = robust_z(cosines, pool_c)
+        flags = []
+        for i, cid in enumerate(ids):
+            reasons = []
+            if abs(zn[i]) > self.mad_threshold:
+                reasons.append("norm_outlier")
+            if zc[i] < -self.mad_threshold:
+                reasons.append("cosine_divergent")
+            if reasons:
+                flags.append({
+                    "client": int(cid), "reasons": reasons,
+                    "norm": float(norms[i]), "norm_z": round(float(zn[i]), 3),
+                    "cosine": float(cosines[i]),
+                    "cosine_z": round(float(zc[i]), 3),
+                })
+        return flags
+
+    def observe_round(self, round_idx: int, ids, weights,
+                      health: Optional[dict],
+                      duration_s: Optional[float] = None) -> dict:
+        ids = np.asarray(ids)
+        weights = np.asarray(weights)
+        real = weights > 0          # mesh-padding duplicates carry weight 0
+        mx.set_gauge("fed.round", float(round_idx))
+        mx.inc("fed.rounds_total")
+        for cid in ids[real]:
+            record_participation(cid)
+
+        flags: list[dict] = []
+        if health is not None:
+            norms = np.asarray(health["update_norm"], np.float64)[real]
+            cosines = np.asarray(health["cosine"], np.float64)[real]
+            mx.set_gauge("fed.health.update_norm_median",
+                         float(np.median(norms)) if norms.size else 0.0)
+            mx.set_gauge("fed.health.cosine_min",
+                         float(cosines.min()) if cosines.size else 0.0)
+            if self.rounds_seen >= self.warmup_rounds:
+                flags = self._flag_clients(ids[real], norms, cosines)
+            self._norms.append(norms)
+            self._cosines.append(cosines)
+
+        straggler = False
+        if duration_s is not None:
+            mx.set_gauge("fed.health.round_s", float(duration_s))
+            pool = np.asarray(list(self._durations) + [duration_s])
+            if self.rounds_seen >= self.warmup_rounds:
+                z = float(robust_z(np.asarray([duration_s]), pool)[0])
+                straggler = z > self.mad_threshold
+            self._durations.append(float(duration_s))
+            if straggler:
+                mx.inc("fed.health.straggler_rounds")
+
+        mx.set_gauge("fed.health.divergent", float(len(flags)))
+        if flags:
+            mx.inc("fed.health.flags_total", len(flags))
+            for f in flags:
+                cid = f["client"]
+                mx.inc(f"fed.health.flags.c{cid}")
+                self.flag_counts[cid] = self.flag_counts.get(cid, 0) + 1
+        if flags or straggler:
+            record = {"health": {"round": int(round_idx), "flags": flags,
+                                 "straggler_round": straggler}}
+            self._rec.log(record)
+            # a zero-duration span puts the anomaly ON the Chrome trace,
+            # time-aligned with the round spans it annotates
+            with self._rec.span(
+                    "health.flag", round=int(round_idx),
+                    straggler=straggler,
+                    clients=",".join(str(f["client"]) for f in flags)):
+                pass
+        self.rounds_seen += 1
+        return {"round": int(round_idx), "flags": flags,
+                "straggler_round": straggler}

@@ -1,0 +1,275 @@
+"""Prometheus text exposition for the metrics registry + /metrics endpoint.
+
+The live half of the run-health plane (ISSUE 3): `render_prometheus()`
+turns a `MetricsRegistry` snapshot into the Prometheus text exposition
+format (version 0.0.4 — HELP/TYPE comments, `_total`-suffixed counters,
+cumulative `_bucket{le=...}`/`_sum`/`_count` histogram series), and
+`MetricsExporter` serves it from a background `ThreadingHTTPServer` so any
+Prometheus scraper — or `python -m fedml_tpu top` — can watch a federation
+run live. Opt-in via `common_args.extra.metrics_port` (0 picks an
+ephemeral port); the Simulator, AsyncSimulator, and CentralizedTrainer all
+call `maybe_start_metrics_server(cfg)` at startup, and the serving tier
+(inference runner + gateway) exposes the same text on its existing HTTP
+servers' `/metrics` route.
+
+`parse_prometheus()` is the inverse — used by `top`, the diagnosis probe,
+and the golden tests, so the exposition is validated by an actual parser,
+not string-matching.
+
+No reference equivalent: the reference ships metrics to its MLOps cloud
+over MQTT; there is no scrape surface.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+import threading
+from typing import Optional
+
+from . import metrics as mx
+
+log = logging.getLogger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Dotted instrument names -> valid Prometheus metric names."""
+    s = _INVALID.sub("_", name)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s or "_"
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_prometheus(snapshot: Optional[dict] = None) -> str:
+    """One registry snapshot as Prometheus text exposition. Counters gain
+    the conventional `_total` suffix; histograms emit CUMULATIVE bucket
+    counts (the registry stores per-bucket counts) with a closing
+    `le="+Inf"` bucket equal to `_count`."""
+    snap = snapshot if snapshot is not None else mx.snapshot()
+    lines: list[str] = []
+    for name in sorted(snap.get("counters", {})):
+        n = sanitize_name(name)
+        if not n.endswith("_total"):
+            n += "_total"
+        lines += [f"# HELP {n} fedml_tpu counter {name}",
+                  f"# TYPE {n} counter",
+                  f"{n} {_fmt(snap['counters'][name])}"]
+    for name in sorted(snap.get("gauges", {})):
+        n = sanitize_name(name)
+        lines += [f"# HELP {n} fedml_tpu gauge {name}",
+                  f"# TYPE {n} gauge",
+                  f"{n} {_fmt(float(snap['gauges'][name]))}"]
+    for name in sorted(snap.get("histograms", {})):
+        h = snap["histograms"][name]
+        n = sanitize_name(name)
+        lines += [f"# HELP {n} fedml_tpu histogram {name}",
+                  f"# TYPE {n} histogram"]
+        cum = 0
+        counts = h.get("counts") or []
+        edges = h.get("edges") or []
+        for edge, c in zip(edges, counts):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{_fmt(float(edge))}"}} {cum}')
+        if len(counts) > len(edges):      # overflow bucket
+            cum += counts[len(edges)]
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {_fmt(float(h.get('sum', 0.0)))}")
+        # _count is emitted as the accumulated bucket total, NOT the
+        # snapshot's separate count field: the lock-free shards update
+        # buckets and count as distinct ops, so a torn scrape could read
+        # them one observation apart — deriving _count from the buckets
+        # keeps the exposition self-consistent (parse_prometheus enforces
+        # +Inf == _count) at every instant
+        lines.append(f"{n}_count {cum}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse text exposition back into
+    {"counters": {name: v}, "gauges": {name: v},
+     "histograms": {name: {"count", "sum", "buckets": [(le, cum), ...]}}}.
+    Names stay in their sanitized exposition form (counters keep `_total`).
+    Raises ValueError on malformed sample lines, so tests using it really
+    do validate the format."""
+    types: dict[str, str] = {}
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ValueError(f"line {lineno}: malformed TYPE: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        name, labels, raw = m.groups()
+        value = float(raw.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if types.get(base) == "histogram":
+            h = out["histograms"].setdefault(
+                base, {"count": 0, "sum": 0.0, "buckets": []})
+            if name.endswith("_bucket"):
+                lem = re.search(r'le="([^"]+)"', labels or "")
+                if not lem:
+                    raise ValueError(
+                        f"line {lineno}: histogram bucket without le label")
+                le = float(lem.group(1).replace("+Inf", "inf"))
+                h["buckets"].append((le, value))
+            elif name.endswith("_sum"):
+                h["sum"] = value
+            elif name.endswith("_count"):
+                h["count"] = int(value)
+            continue
+        if types.get(name) == "counter":
+            out["counters"][name] = value
+        else:
+            out["gauges"][name] = value
+    # cumulative bucket sanity: monotone, +Inf == count
+    for base, h in out["histograms"].items():
+        prev = 0.0
+        for le, cum in h["buckets"]:
+            if cum < prev:
+                raise ValueError(
+                    f"{base}: non-monotonic cumulative bucket at le={le}")
+            prev = cum
+        if h["buckets"] and not math.isinf(h["buckets"][-1][0]):
+            raise ValueError(f"{base}: missing le=\"+Inf\" bucket")
+        if h["buckets"] and int(h["buckets"][-1][1]) != h["count"]:
+            raise ValueError(
+                f"{base}: +Inf bucket {h['buckets'][-1][1]} != "
+                f"count {h['count']}")
+    return out
+
+
+def histogram_percentile(buckets, q: float) -> Optional[float]:
+    """Percentile from PARSED cumulative buckets (the `top` verb's path):
+    de-accumulate, then reuse the registry's percentile_from_counts."""
+    if not buckets:
+        return None
+    edges = [le for le, _ in buckets if not math.isinf(le)]
+    cums = [c for _, c in buckets]
+    counts, prev = [], 0.0
+    for c in cums:
+        counts.append(int(c - prev))
+        prev = c
+    return mx.percentile_from_counts(edges, counts, q)
+
+
+def write_metrics_response(handler) -> None:
+    """Serve the current registry as a /metrics response on any
+    BaseHTTPRequestHandler (shared by the exporter, the inference runner,
+    and the serving gateway)."""
+    body = render_prometheus().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class MetricsExporter:
+    """Background /metrics HTTP server over the process-wide registry."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("metrics: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path in ("/metrics", "/"):
+                    write_metrics_response(self)
+                else:
+                    body = b"see /metrics\n"
+                    self.send_response(404)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self) -> "MetricsExporter":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fedml-metrics-exporter")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# one exporter per process: Simulator / AsyncSimulator / CentralizedTrainer
+# all call maybe_start_metrics_server at startup; the registry is process-
+# wide, so a second engine in the same process reuses the first endpoint.
+_exporter: Optional[MetricsExporter] = None
+_exporter_lock = threading.Lock()
+
+
+def current_exporter() -> Optional[MetricsExporter]:
+    return _exporter
+
+
+def maybe_start_metrics_server(cfg) -> Optional[MetricsExporter]:
+    """Start (or return) the process's /metrics endpoint when
+    `common_args.extra.metrics_port` is set; port 0 binds an ephemeral port
+    (the resolved port is on the returned exporter). Degrades instead of
+    dying: a bind failure logs a warning and returns None — losing a
+    training run to a busy port would be worse than losing the scrape."""
+    global _exporter
+    port = cfg.common_args.extra.get("metrics_port")
+    if port is None:
+        return None
+    with _exporter_lock:
+        if _exporter is not None:
+            if int(port) not in (0, _exporter.port):
+                log.warning(
+                    "metrics_port=%r requested but this process's /metrics "
+                    "endpoint is already bound on port %d — reusing it "
+                    "(one exporter per process; the registry is process-"
+                    "wide)", port, _exporter.port)
+            return _exporter
+        try:
+            _exporter = MetricsExporter(port=int(port)).start()
+            log.info("metrics endpoint on %s", _exporter.url)
+        except OSError as e:
+            log.warning("metrics_port=%r could not be bound (continuing "
+                        "without /metrics): %s", port, e)
+            return None
+        return _exporter

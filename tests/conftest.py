@@ -48,3 +48,20 @@ def _isolate_recorder():
     with recorder._agg_lock:
         recorder._agg.clear()
         recorder._agg.update(agg)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_metrics_registry():
+    """The recorder fixture above left the process-global MetricsRegistry
+    (utils/metrics.py) shared across tests, so counter assertions (e.g.
+    test_comm_bench's byte floors) could bleed across test order. Swap in a
+    fresh registry per test — every writer resolves `metrics.registry` at
+    call time, so in-flight instruments from daemons a previous test leaked
+    keep writing into the OLD registry harmlessly — and restore the
+    original afterwards."""
+    from fedml_tpu.utils import metrics as mx
+
+    prev = mx.registry
+    mx.registry = mx.MetricsRegistry()
+    yield
+    mx.registry = prev

@@ -1,0 +1,461 @@
+"""Run-health plane (ISSUE 3): in-jit per-client health stats, MAD anomaly
+flags, participation/staleness accounting, the Prometheus exposition +
+/metrics endpoint, and the `top`/`report` CLI verbs."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.utils import metrics as mx
+from fedml_tpu.utils.health import (
+    HealthTracker, record_participation, record_staleness, robust_z,
+)
+from fedml_tpu.utils.prometheus import (
+    MetricsExporter, current_exporter, histogram_percentile,
+    parse_prometheus, render_prometheus,
+)
+
+
+def _cfg(backend="sp", comm_round=4, **extra):
+    return fedml_tpu.init(config={
+        "common_args": {"training_type": "simulation", "random_seed": 0},
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 32}},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 8, "client_num_per_round": 5,
+            "comm_round": comm_round, "epochs": 1, "batch_size": 8,
+            "learning_rate": 0.1, "extra": extra,
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": backend},
+    })
+
+
+# --------------------------------------------------- in-jit health arrays
+def test_round_health_arrays_on_mesh_with_padding():
+    """backend=xla, 5 sampled clients padded to 8 mesh slots: the health
+    arrays come back [m]-shaped per slot, padding rows are masked by weight
+    host-side, and the per-round gauges/counters land in the registry."""
+    from fedml_tpu.simulation.simulator import Simulator
+
+    sim = Simulator(_cfg(backend="xla"))
+    assert sim.mesh is not None
+    sim.run()
+    snap = mx.snapshot()
+    assert snap["counters"]["fed.rounds_total"] == 4
+    assert snap["gauges"]["fed.round"] == 3.0
+    # participation counted for REAL clients only: 4 rounds x 5 sampled
+    part = {k: v for k, v in snap["counters"].items()
+            if k.startswith("fed.participation.")}
+    assert sum(part.values()) == 4 * 5
+    assert snap["gauges"]["fed.health.update_norm_median"] > 0
+    assert -1.0 - 1e-6 <= snap["gauges"]["fed.health.cosine_min"] <= 1.0 + 1e-6
+
+
+def test_full_mode_health_arrays():
+    """FULL-mode aggregation (krum defense forces the all-gather path) still
+    carries the health stats — the per-client loss rides out of the
+    shard_map so the jit-level aggregate can join it."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.simulation.simulator import Simulator
+
+    cfg = _cfg(backend="xla", comm_round=2)
+    cfg.security_args.enable_defense = True
+    cfg.security_args.defense_type = "krum"
+    cfg.security_args.defense_spec = {"byzantine_client_num": 1}
+    sim = Simulator(cfg)
+    assert sim._use_full
+    sim.run()
+    ids, weights = sim._pad_ids(sim.sample_clients(0))
+    out = sim.round_fn(
+        sim.server_state, sim.client_states, sim.data,
+        jnp.asarray(ids), jnp.asarray(weights),
+        jax.random.fold_in(jax.random.key(0), 7), sim.hook_state)
+    h = jax.device_get(out.metrics["health"])
+    assert h["update_norm"].shape == (len(ids),)
+    assert np.all(h["update_norm"] >= 0)
+    assert np.all(np.abs(h["cosine"]) <= 1.0 + 1e-5)
+
+
+# ------------------------------------------------------ MAD anomaly flags
+def _feed(tracker, r, norms, cosines, duration=None):
+    m = len(norms)
+    return tracker.observe_round(
+        r, np.arange(m), np.ones(m, np.float32),
+        {"update_norm": np.asarray(norms, np.float64),
+         "cosine": np.asarray(cosines, np.float64),
+         "loss_delta": np.zeros(m)},
+        duration_s=duration)
+
+
+def test_mad_flags_divergent_client_after_warmup():
+    tr = HealthTracker(mad_threshold=3.5, warmup_rounds=2, window=10)
+    base_n = [1.0, 1.1, 0.9, 1.05, 0.95, 1.0, 1.02, 0.98]
+    base_c = [0.99, 0.98, 0.97, 0.99, 0.98, 0.99, 0.97, 0.98]
+    # warm-up: even an outlier is NOT flagged while the window fills
+    bad_n = list(base_n)
+    bad_n[3] = 50.0
+    out = _feed(tr, 0, bad_n, base_c)
+    assert out["flags"] == []
+    _feed(tr, 1, base_n, base_c)
+    # post-warmup: norm outlier on client 3, cosine divergence on client 6
+    bad_c = list(base_c)
+    bad_c[6] = -0.8
+    out = _feed(tr, 2, bad_n, bad_c)
+    by_client = {f["client"]: f for f in out["flags"]}
+    assert "norm_outlier" in by_client[3]["reasons"]
+    assert "cosine_divergent" in by_client[6]["reasons"]
+    snap = mx.snapshot()
+    assert snap["counters"]["fed.health.flags_total"] >= 2
+    assert snap["counters"]["fed.health.flags.c3"] >= 1
+    assert snap["counters"]["fed.health.flags.c6"] >= 1
+    assert snap["gauges"]["fed.health.divergent"] == len(out["flags"])
+    # well-behaved cohort afterwards -> no flags, gauge falls back to 0
+    out = _feed(tr, 3, base_n, base_c)
+    assert out["flags"] == []
+    assert mx.snapshot()["gauges"]["fed.health.divergent"] == 0.0
+
+
+def test_flags_emit_recorder_row_and_trace_span():
+    from fedml_tpu.utils.events import EventRecorder
+
+    rec = EventRecorder(max_rows=100)
+    tr = HealthTracker(mad_threshold=3.0, warmup_rounds=1, window=10,
+                       recorder=rec)
+    base = [1.0, 1.05, 0.95, 1.02, 0.98, 1.01, 0.99, 1.03]
+    cos = [0.99] * 8
+    _feed(tr, 0, base, cos)
+    bad = list(base)
+    bad[2] = 40.0
+    out = _feed(tr, 1, bad, cos)
+    assert out["flags"] and out["flags"][0]["client"] == 2
+    rows = [m for m in rec.metrics if "health" in m]
+    assert rows and rows[-1]["health"]["round"] == 1
+    assert rows[-1]["health"]["flags"][0]["client"] == 2
+    spans = [s for s in rec.spans if s.name == "health.flag"]
+    assert spans and "2" in spans[-1].meta["clients"]
+
+
+def test_straggler_round_detection():
+    tr = HealthTracker(mad_threshold=3.0, warmup_rounds=3, window=10)
+    norms = [1.0, 1.1, 0.9, 1.05]
+    cos = [0.99] * 4
+    for r in range(6):
+        out = _feed(tr, r, norms, cos, duration=0.1 + 0.001 * r)
+        assert not out["straggler_round"]
+    out = _feed(tr, 6, norms, cos, duration=5.0)
+    assert out["straggler_round"]
+    assert mx.snapshot()["counters"]["fed.health.straggler_rounds"] == 1
+
+
+def test_robust_z_degenerate_pool_yields_no_flags():
+    z = robust_z(np.array([1.0, 1.0, 5.0]), np.array([1.0] * 50))
+    assert np.all(z == 0)          # MAD=0 -> zeros, not infs
+
+
+def test_tracker_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="health knobs"):
+        HealthTracker(mad_threshold=0)
+    with pytest.raises(ValueError, match="health knobs"):
+        HealthTracker(window=0)
+
+
+# ------------------------------------------- staleness / async accounting
+def test_async_simulator_records_staleness_and_participation():
+    from fedml_tpu.simulation.async_simulator import AsyncSimulator
+
+    cfg = _cfg(comm_round=4)
+    cfg.train_args.client_num_per_round = 2
+    sim = AsyncSimulator(cfg)
+    sim.run()
+    snap = mx.snapshot()
+    st = snap["histograms"]["fed.staleness"]
+    assert st["count"] == 4 * 2            # one observation per merge
+    assert st["p50"] is not None
+    part = {k: v for k, v in snap["counters"].items()
+            if k.startswith("fed.participation.")}
+    assert sum(part.values()) == 4 * 2
+    assert snap["gauges"]["fed.version"] == 8.0
+    # history rows still carry staleness (unchanged behavior)
+    assert all("staleness" in r for r in sim.history)
+
+
+def test_record_staleness_buckets_integers():
+    record_staleness(0)
+    record_staleness(3)
+    record_staleness(500)      # beyond the last edge -> overflow bucket
+    h = mx.snapshot()["histograms"]["fed.staleness"]
+    assert h["count"] == 3 and h["max"] == 500
+    record_participation(42)
+    assert mx.snapshot()["counters"]["fed.participation.c42"] == 1
+
+
+# -------------------------------------------- percentile_from_counts edges
+def test_percentile_from_counts_empty():
+    assert mx.percentile_from_counts((1, 2, 4), [0, 0, 0, 0], 0.5) is None
+    assert mx.percentile_from_counts((), [], 0.99) is None
+
+
+def test_percentile_from_counts_all_overflow():
+    edges = (1.0, 2.0, 4.0)
+    counts = [0, 0, 0, 5]          # every observation beyond the last edge
+    assert mx.percentile_from_counts(edges, counts, 0.5,
+                                     observed_max=7.5) == 7.5
+    assert mx.percentile_from_counts(edges, counts, 0.5) == 4.0
+
+
+def test_percentile_from_counts_delta_path():
+    """comm_bench-style: percentiles from the DIFFERENCE of two cumulative
+    snapshots isolate one run's distribution."""
+    h = mx.histogram("t.delta", buckets=(1.0, 2.0, 4.0))
+    h.observe(0.5)
+    before = list(h.snapshot()["counts"])
+    for v in (3.0, 3.0, 3.0, 0.5):
+        h.observe(v)
+    after = h.snapshot()["counts"]
+    delta = [a - b for a, b in zip(after, before)]
+    assert sum(delta) == 4
+    assert mx.percentile_from_counts((1.0, 2.0, 4.0), delta, 0.5) == 4.0
+    assert mx.percentile_from_counts((1.0, 2.0, 4.0), delta, 0.01) == 1.0
+
+
+# ------------------------------------------------- Prometheus exposition
+def test_prometheus_render_golden():
+    mx.inc("t.prom.counter", 7)
+    mx.set_gauge("t.prom.gauge", 2.5)
+    h = mx.histogram("t.prom.hist", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = render_prometheus()
+    lines = text.splitlines()
+    # HELP/TYPE lines present for every series
+    assert "# TYPE t_prom_counter_total counter" in lines
+    assert "# HELP t_prom_counter_total fedml_tpu counter t.prom.counter" \
+        in lines
+    assert "# TYPE t_prom_gauge gauge" in lines
+    assert "# TYPE t_prom_hist histogram" in lines
+    assert "t_prom_counter_total 7" in lines
+    assert "t_prom_gauge 2.5" in lines
+    # cumulative buckets: 0.05<=0.1; two at 1.0; one at 10.0; one overflow
+    assert 't_prom_hist_bucket{le="0.1"} 1' in lines
+    assert 't_prom_hist_bucket{le="1"} 3' in lines
+    assert 't_prom_hist_bucket{le="10"} 4' in lines
+    assert 't_prom_hist_bucket{le="+Inf"} 5' in lines
+    assert "t_prom_hist_count 5" in lines
+    assert any(l.startswith("t_prom_hist_sum ") for l in lines)
+    # and the whole document PARSES (the parser validates monotonicity and
+    # the +Inf==count invariant)
+    parsed = parse_prometheus(text)
+    assert parsed["counters"]["t_prom_counter_total"] == 7
+    assert parsed["gauges"]["t_prom_gauge"] == 2.5
+    ph = parsed["histograms"]["t_prom_hist"]
+    assert ph["count"] == 5
+    assert histogram_percentile(ph["buckets"], 0.5) == 1.0
+
+
+def test_prometheus_parser_rejects_malformed():
+    with pytest.raises(ValueError, match="malformed sample"):
+        parse_prometheus("this is not prometheus\n")
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+           "h_sum 1\nh_count 3\n")
+    with pytest.raises(ValueError, match="non-monotonic"):
+        parse_prometheus(bad)
+
+
+def test_metrics_endpoint_serves_during_w1_run():
+    """Acceptance: /metrics serves valid exposition WHILE a run is in
+    flight — a w1-shaped (10-client LR FedAvg sp) run on a background
+    thread, scraped and parser-validated mid-run."""
+    from fedml_tpu.simulation.simulator import Simulator
+    import fedml_tpu.utils.prometheus as prom
+
+    cfg = _cfg(comm_round=30)
+    cfg.common_args.extra["metrics_port"] = 0
+    # isolate the process-global exporter for this test
+    old = prom._exporter
+    prom._exporter = None
+    exp = None
+    try:
+        sim = Simulator(cfg)
+        exp = sim.metrics_exporter
+        assert exp is not None and exp is current_exporter()
+        t = threading.Thread(target=lambda: sim.run(), daemon=True)
+        t.start()
+        mid = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            text = urllib.request.urlopen(exp.url, timeout=5).read().decode()
+            parsed = parse_prometheus(text)       # raises if invalid
+            # a scrape showing 1..29 completed rounds was BY VALUE taken
+            # while the run was in flight, whatever the thread does next
+            if 1 <= parsed["counters"].get("fed_rounds_total", 0) < 30:
+                mid = parsed
+                break
+            if not t.is_alive():
+                break
+            time.sleep(0.005)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert mid is not None, \
+            "never scraped a valid snapshot while the run was in flight"
+        assert "fed_round" in mid["gauges"]
+        final = parse_prometheus(
+            urllib.request.urlopen(exp.url, timeout=5).read().decode())
+        assert final["counters"]["fed_rounds_total"] == 30
+        assert any(k.startswith("fed_participation_c")
+                   for k in final["counters"])
+    finally:
+        if exp is not None:
+            exp.stop()
+        prom._exporter = old
+
+
+def test_metrics_port_validated_at_config_load():
+    for bad in (-1, 70000, "http", 1.5, True):
+        with pytest.raises(ValueError, match="metrics_port"):
+            cfg = {"common_args": {"extra": {"metrics_port": bad}}}
+            fedml_tpu.init(config=cfg)
+    fedml_tpu.init(config={"common_args": {"extra": {"metrics_port": 0}}})
+
+
+def test_serving_runner_exposes_metrics_route():
+    import jax
+
+    from fedml_tpu.models import hub
+    from fedml_tpu.serving import FedMLInferenceRunner, JaxPredictor
+
+    model = hub.create("lr", 3)
+    params = hub.init_params(model, (8,), jax.random.key(0))
+    runner = FedMLInferenceRunner(JaxPredictor(model.apply, params), port=0)
+    runner.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{runner.port}/predict",
+            data=json.dumps({"inputs": np.zeros((2, 8)).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{runner.port}/metrics",
+            timeout=5).read().decode()
+        parsed = parse_prometheus(text)
+        assert parsed["counters"].get("serving_requests_total", 0) >= 1
+        assert "serving_request_s" in parsed["histograms"]
+    finally:
+        runner.stop()
+
+
+# --------------------------------------------------------------- top verb
+def test_top_once_renders_run_health(capsys):
+    from fedml_tpu.__main__ import main as cli_main
+
+    # seed the registry with a representative cross-section
+    mx.set_gauge("fed.round", 17)
+    mx.inc("fed.rounds_total", 18)
+    mx.inc("fed.participation.c0", 12)
+    mx.inc("fed.participation.c3", 9)
+    mx.inc("fed.health.flags.c3", 2)
+    mx.inc("fed.health.flags_total", 2)
+    mx.set_gauge("fed.health.divergent", 1)
+    record_staleness(1)
+    record_staleness(4)
+    mx.inc("comm.loopback.bytes_sent", 2048)
+    mx.inc("comm.loopback.bytes_recv", 4096)
+    mx.inc("serving.requests", 3)
+    exp = MetricsExporter(port=0).start()
+    try:
+        rc = cli_main(["top", "--once", "--url", exp.url])
+    finally:
+        exp.stop()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "round 17" in out and "rounds_total 18" in out
+    assert "c0:12" in out and "c3:9" in out          # participation table
+    assert "c3x2" in out                             # anomaly flags
+    assert "staleness: n=2" in out
+    assert "comm[loopback]" in out and "2.0KB" in out
+    assert "serving: requests 3" in out
+
+
+def test_top_port_shorthand_and_rates(capsys):
+    from fedml_tpu.__main__ import main as cli_main
+
+    mx.inc("fed.rounds_total", 5)
+    exp = MetricsExporter(port=0).start()
+    try:
+        rc = cli_main(["top", "--port", str(exp.port), "--frames", "2",
+                       "--interval", "0.05"])
+    finally:
+        exp.stop()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rounds/s" in out       # second frame has a delta to rate from
+
+
+def test_top_run_dir_fallback(tmp_path, capsys):
+    """No --url: top reads the newest run's end-of-run metrics snapshot and
+    renders the same screen from it."""
+    from fedml_tpu.__main__ import main as cli_main
+
+    snap = {"counters": {"fed.rounds_total": 9, "fed.participation.c1": 9},
+            "gauges": {"fed.round": 8.0},
+            "histograms": {}}
+    p = tmp_path / "myrun.events.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"t": time.time(), "kind": "metrics",
+                            "report": {"spans": {}, "metrics": snap}}) + "\n")
+    rc = cli_main(["top", "--once", "--log-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "round 8" in out and "rounds_total 9" in out and "c1:9" in out
+
+
+def test_top_errors_cleanly_without_source(tmp_path, capsys):
+    from fedml_tpu.__main__ import main as cli_main
+
+    rc = cli_main(["top", "--once", "--log-dir", str(tmp_path / "nope")])
+    assert rc == 1
+    assert "top:" in capsys.readouterr().err
+
+
+# ----------------------------------------------------- report CLI satellite
+def test_report_exits_nonzero_on_empty_run(tmp_path, capsys):
+    from fedml_tpu.__main__ import main as cli_main
+
+    p = tmp_path / "empty.events.jsonl"
+    p.write_text("")
+    rc = cli_main(["report", "--events", str(p)])
+    assert rc == 1
+    assert "no telemetry rows" in capsys.readouterr().err
+
+
+# ------------------------------------------------------ events.py satellite
+def test_events_cap_env_resolved_at_construction(monkeypatch):
+    from fedml_tpu.utils.events import DEFAULT_EVENTS_CAP, EventRecorder
+
+    monkeypatch.setenv("FEDML_TPU_EVENTS_CAP", "7")
+    rec = EventRecorder()                  # env read NOW, not at import
+    assert rec.spans.maxlen == 7 and rec.metrics.maxlen == 7
+    monkeypatch.setenv("FEDML_TPU_EVENTS_CAP", "not-a-number")
+    rec = EventRecorder()
+    assert rec.spans.maxlen == DEFAULT_EVENTS_CAP
+    monkeypatch.delenv("FEDML_TPU_EVENTS_CAP")
+    assert EventRecorder(max_rows=11).spans.maxlen == 11   # explicit wins
+
+
+# ------------------------------------------------------- registry isolation
+def test_metrics_registry_is_isolated_per_test():
+    """The conftest fixture swaps in a fresh registry per test: instruments
+    bumped by the many sims above must not be visible here."""
+    snap = mx.snapshot()
+    assert "fed.rounds_total" not in snap["counters"]
+    mx.inc("t.isolation.canary")
+    assert mx.snapshot()["counters"]["t.isolation.canary"] == 1

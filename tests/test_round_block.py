@@ -130,6 +130,52 @@ def _raw():
     }
 
 
+def test_health_stats_do_not_change_training():
+    """The in-jit per-client health stats (ISSUE 3) are observation-only:
+    a run with health_stats=False produces EXACTLY (rtol=0) the history and
+    final params of the default-on run — the health arrays are extra
+    outputs, never inputs."""
+    on = Simulator(_cfg())             # health_stats defaults to on
+    on.run()
+    off = Simulator(_cfg(extra={"health_stats": False}))
+    off.run()
+    assert len(on.history) == len(off.history)
+    for a, b in zip(on.history, off.history):
+        assert a == b, f"history diverged at round {a['round']}"
+    _assert_trees_match(on.server_state.params, off.server_state.params,
+                        rtol=0, atol=0)
+
+
+def test_health_block_equivalence_and_single_transfer_shape():
+    """Acceptance pin (ISSUE 3): with health enabled (the default), blocked
+    K=4 and per-round runs still produce identical history/params/
+    client_states — the existing equivalence suite runs health-on already;
+    this pin additionally checks the health arrays themselves ride the
+    metrics transfer with the right shape and sane values in BOTH engines,
+    on the 8-device mesh with pad rounds (5 sampled -> 8 slots)."""
+    import jax.numpy as jnp
+
+    over = dict(client_num_in_total=12, client_num_per_round=5)
+    ref, blk = _run_pair(backend="xla", rounds_per_block=4, **over)
+    _assert_histories_match(ref.history, blk.history)
+    _assert_trees_match(ref.server_state.params, blk.server_state.params)
+    # both trackers saw every round
+    assert ref.health is not None and blk.health is not None
+    assert ref.health.rounds_seen == blk.health.rounds_seen == 12
+    # the health arrays really are per-slot [m] outputs of the jitted round
+    ids, weights = ref._pad_ids(ref.sample_clients(0))
+    out = ref.round_fn(
+        ref.server_state, ref.client_states, ref.data,
+        jnp.asarray(ids), jnp.asarray(weights),
+        jax.random.fold_in(jax.random.key(0), 99), ref.hook_state)
+    h = jax.device_get(out.metrics["health"])
+    assert set(h) == {"update_norm", "cosine", "loss_delta"}
+    for v in h.values():
+        assert v.shape == (len(ids),)
+    assert np.all(h["update_norm"] >= 0)
+    assert np.all(np.abs(h["cosine"]) <= 1.0 + 1e-5)
+
+
 def test_k1_uses_per_round_driver():
     """rounds_per_block=1 must reduce to today's behavior exactly: the
     blocked driver is never entered and the block fn is never built."""
