@@ -378,6 +378,79 @@ def bench_workload1_mnist_lr() -> dict:
     return out
 
 
+def bench_reliable_comm() -> dict:
+    """Reliable-delivery overhead (ISSUE 4): the 2-client cross-silo
+    loopback federation run with plain transports vs with the reliable
+    layer (seq/ack/retransmit/dedup, comm/reliable.py) stacked on — no
+    chaos injected, so the measured cost is pure bookkeeping: one ack frame
+    and one dedup-window probe per message. Budget < 2% of workload wall
+    time: reliability must be cheap enough to leave on for every real
+    cross-silo run."""
+    import threading  # noqa: F401 — managers spawn their own threads
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.comm import FedCommManager, create_transport
+    from fedml_tpu.comm.loopback import release_router
+    from fedml_tpu.config import TrainArgs
+    from fedml_tpu.cross_silo import (
+        FedClientManager, FedServerManager, SiloTrainer,
+    )
+    from fedml_tpu.models import hub
+
+    rounds = 5
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=2, batch_size=16, learning_rate=0.3,
+                  client_num_in_total=2, client_num_per_round=2,
+                  comm_round=rounds)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+
+    def make_trainer(seed):
+        rs = np.random.RandomState(seed)
+        n, d = 256, 8
+        w_true = rs.randn(d, 3)
+        x = rs.randn(n, d).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+        return SiloTrainer(model.apply, t, x, y, seed=seed)
+
+    def one_run(tag, comm_retry):
+        run_id = f"bench-rel-{tag}"
+        mk = lambda r: FedCommManager(  # noqa: E731
+            create_transport("loopback", r, run_id, comm_retry=comm_retry), r)
+        server = FedServerManager(mk(0), client_ids=[1, 2],
+                                  init_params=params_np, num_rounds=rounds)
+        clients = [FedClientManager(mk(cid), cid, make_trainer(cid))
+                   for cid in (1, 2)]
+        t0 = time.perf_counter()
+        server.run(background=True)
+        for c in clients:
+            c.run(background=True)
+            c.announce_ready()
+        ok = server.done.wait(timeout=120)
+        dt = time.perf_counter() - t0
+        for c in clients:
+            c.done.wait(timeout=10)
+        release_router(run_id)
+        if not ok:
+            raise TimeoutError(f"reliable-comm bench {tag!r} did not finish")
+        return dt
+
+    one_run("warm0", None)      # compile the jitted train path off the clock
+    # best-of-2 per variant: these are threaded wall-clock runs, and one
+    # scheduler hiccup would otherwise masquerade as protocol overhead
+    dt_plain = min(one_run(f"plain{i}", None) for i in range(2))
+    dt_rel = min(one_run(f"rel{i}", {"ack_timeout_s": 0.25})
+                 for i in range(2))
+    return {
+        "w1_reliable_comm_overhead_pct": round(
+            max(dt_rel / dt_plain - 1.0, 0.0) * 100, 2),
+        "w1_reliable_comm_budget_pct": 2.0,
+        "w1_reliable_round_ms": round(dt_rel / rounds * 1e3, 1),
+    }
+
+
 def bench_workload4_hierarchical() -> dict:
     """BASELINE workload 4: hierarchical cross-silo — per-silo inner
     allreduce (intra axis) + outer aggregate (silos axis), one XLA program
@@ -937,6 +1010,8 @@ _HEADLINE_KEYS = (
     "w1_mnist_lr_sp_rounds_per_sec", "w1_blocked_rounds_per_sec",
     "w1_blocked_speedup", "w1_telemetry_overhead_pct",
     "w1_health_overhead_pct",
+    # chaos plane + reliable delivery (ISSUE 4): protocol-overhead row
+    "w1_reliable_comm_overhead_pct",
     "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",
@@ -990,6 +1065,8 @@ def main():
         "real_data_final_acc_digits_noniid": None}
     acc.update(_retrying(bench_workload1_mnist_lr, default=None) or
                {"w1_error": "bench_workload1 failed twice"})
+    acc.update(_retrying(bench_reliable_comm, default=None) or
+               {"w1_reliable_comm_error": "bench_reliable_comm failed twice"})
     if not quick:
         acc.update(_retrying(bench_workload4_hierarchical, default=None) or
                    {"w4_error": "bench_workload4 failed twice"})

@@ -8,9 +8,11 @@ does not exist here at all — it's XLA collectives inside the round program
 (parallel/round.py), per SURVEY.md §5.8.
 """
 from .base import BaseTransport, Observer
+from .chaos import ChaosTransport, FaultSpec
 from .loopback import LoopbackTransport, get_router
 from .manager import FedCommManager, create_transport
 from .message import Message
+from .reliable import DeliveryError, ReliableTransport, RetryPolicy
 from .serialization import decode, encode
 from .topology import AsymmetricTopologyManager, SymmetricTopologyManager
 
@@ -18,4 +20,6 @@ __all__ = [
     "BaseTransport", "Observer", "Message", "FedCommManager",
     "create_transport", "LoopbackTransport", "get_router", "encode", "decode",
     "SymmetricTopologyManager", "AsymmetricTopologyManager",
+    "ChaosTransport", "FaultSpec", "ReliableTransport", "RetryPolicy",
+    "DeliveryError",
 ]

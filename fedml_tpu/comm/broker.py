@@ -175,12 +175,18 @@ class BrokerTransport(BaseTransport):
 
     def __init__(self, rank: int, run_id: str = "default",
                  broker: Optional[InMemoryBroker] = None,
-                 blob_threshold: int = 16 * 1024):
+                 blob_threshold: int = 16 * 1024,
+                 publish_retries: int = 2, retry_backoff_s: float = 0.05):
         super().__init__()
         self.rank = rank
         self.run_id = run_id
         self.broker = broker if broker is not None else get_broker(run_id)
         self.blob_threshold = blob_threshold
+        # publish retry (ISSUE 4): the in-memory broker never fails, but the
+        # broker contract exists to be pointed at a REAL store — a transient
+        # publish/put failure there should cost a retry, not the run
+        self.publish_retries = int(publish_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         # out-of-band stop: an in-band sentinel could be left queued in the
         # topic and would kill the NEXT transport that reconnects to it,
         # stranding store-and-forward frames behind the stale marker
@@ -188,6 +194,26 @@ class BrokerTransport(BaseTransport):
 
     def _topic(self, rank: int) -> str:
         return f"fedml_{self.run_id}_{rank}"
+
+    def _with_retry(self, what: str, fn):
+        """Run a broker-store call with bounded retry + linear backoff;
+        attempts beyond the first are counted as comm.broker.<what>_retries.
+        The final failure propagates — callers see the same exception they
+        always did, just after the transient window has been ridden out."""
+        import logging
+
+        for attempt in range(self.publish_retries + 1):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — broker-store contract
+                if attempt >= self.publish_retries:
+                    raise
+                _mx.inc(f"comm.broker.{what}_retries")
+                logging.getLogger(__name__).warning(
+                    "broker %s failed (attempt %d/%d, retrying): %s: %s",
+                    what, attempt + 1, self.publish_retries + 1,
+                    type(e).__name__, e)
+                time.sleep(self.retry_backoff_s * (attempt + 1))
 
     def send_message(self, msg: Message) -> None:
         # encode the RECEIVER-CANONICAL frame first (receiver forced to -1):
@@ -203,7 +229,8 @@ class BrokerTransport(BaseTransport):
         canonical = self._encode_frame(
             Message(msg.type, msg.sender_id, -1, msg.params), stamp=False)
         if len(canonical) > self.blob_threshold:
-            key = self.broker.put_blob(canonical)
+            key = self._with_retry(
+                "blob_put", lambda: self.broker.put_blob(canonical))
             from ..utils.events import current_trace
 
             tid, sid = current_trace()
@@ -218,7 +245,9 @@ class BrokerTransport(BaseTransport):
             msg.stamp_trace()
             frame = msg.encode()
         t0 = time.perf_counter()
-        self.broker.publish(self._topic(msg.receiver_id), frame)
+        self._with_retry(
+            "publish",
+            lambda: self.broker.publish(self._topic(msg.receiver_id), frame))
         _mx.observe("comm.broker.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:

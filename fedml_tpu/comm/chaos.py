@@ -1,0 +1,332 @@
+"""Chaos plane — deterministic fault injection for the comm stack.
+
+The reference's sync runtimes simply hang or crash when a client or link
+fails (SURVEY §5.4); before this layer the repo could not even *reproduce*
+such a failure on demand. `FaultSpec` is a seeded, declarative fault plan;
+`ChaosTransport` wraps any `BaseTransport` and injects per-link
+drop/delay/duplicate/reorder/corrupt faults plus per-rank crash/flap
+schedules on the send path. Injection is fully deterministic: each fault
+draw is keyed by (seed, sender, receiver, per-link sequence number), so the
+same plan against the same protocol run injects the same faults regardless
+of thread timing — a failing chaos run replays.
+
+Every injected fault is counted (`fed.chaos.*` — scraped by `/metrics` and
+`fedml_tpu top`) and emitted as a zero-duration `comm.chaos.<fault>` span,
+so faults land on the Chrome trace's comm track time-aligned with the sends
+they perturbed.
+
+`FaultSpec` also carries the CLIENT-fault rates (`client_dropout` /
+`client_straggler`) consumed by the simulators: those masks are applied
+inside the jitted round program (parallel/round.py), not here — this module
+stays jax-free so config validation can load it without dragging a backend
+in.
+
+The spec rides config as `common_args.extra.chaos` and is validated at
+config load (config.py), so a typo'd plan fails before a run starts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import struct
+import threading
+from typing import Optional
+
+from ..utils import metrics as _mx
+from ..utils.events import recorder
+from .base import BaseTransport, Observer
+from .message import Message
+
+log = logging.getLogger(__name__)
+
+# link-fault probability knobs (all in [0, 1])
+_PROB_FIELDS = ("drop", "duplicate", "delay", "reorder", "corrupt")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Seeded fault plan. All probabilities are per-message and independent;
+    `crash`/`flap` are per-rank schedules keyed by the SENDER's rank and
+    counted in that rank's outbound sends.
+
+      seed             — root of every fault draw (same seed => same faults)
+      drop             — P(message silently dropped)
+      duplicate        — P(message delivered twice)
+      delay            — P(message held before delivery)
+      delay_max_s      — uniform hold in [0, delay_max_s) when delayed
+      reorder          — P(message held an EXTRA beat so later sends pass it)
+      corrupt          — P(frame bytes tampered in flight; the wire codec's
+                         CRC / parse rejects it at the receiver)
+      crash            — {rank: n}: rank's outbound link goes permanently
+                         dark after its n-th send
+      flap             — {rank: {"up": u, "down": d}}: rank's outbound link
+                         cycles u delivered sends then d dropped sends
+      client_dropout   — P(a sampled client's update is lost this round)
+                         (in-jit mask, parallel/round.py)
+      client_straggler — P(a sampled client misses the round deadline; its
+                         report is discarded like a timeout-closed round)
+    """
+
+    seed: int = 0
+    drop: float = 0.0
+    duplicate: float = 0.0
+    delay: float = 0.0
+    delay_max_s: float = 0.05
+    reorder: float = 0.0
+    corrupt: float = 0.0
+    crash: dict = dataclasses.field(default_factory=dict)
+    flap: dict = dataclasses.field(default_factory=dict)
+    client_dropout: float = 0.0
+    client_straggler: float = 0.0
+
+    def __post_init__(self):
+        for f in _PROB_FIELDS + ("client_dropout", "client_straggler"):
+            v = getattr(self, f)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not 0.0 <= float(v) <= 1.0:
+                raise ValueError(
+                    f"common_args.extra.chaos.{f} must be a probability in "
+                    f"[0, 1]; got {v!r}")
+        if not isinstance(self.delay_max_s, (int, float)) \
+                or isinstance(self.delay_max_s, bool) or self.delay_max_s < 0:
+            raise ValueError(
+                "common_args.extra.chaos.delay_max_s must be a non-negative "
+                f"number of seconds; got {self.delay_max_s!r}")
+        if not isinstance(self.seed, int) or isinstance(self.seed, bool):
+            raise ValueError(
+                f"common_args.extra.chaos.seed must be an int; got "
+                f"{self.seed!r}")
+        for name, sched in (("crash", self.crash), ("flap", self.flap)):
+            if not isinstance(sched, dict):
+                raise ValueError(
+                    f"common_args.extra.chaos.{name} must be a dict keyed by "
+                    f"rank; got {sched!r}")
+        for rank, n in self.crash.items():
+            if not (isinstance(n, int) and not isinstance(n, bool) and n >= 0):
+                raise ValueError(
+                    "common_args.extra.chaos.crash values must be "
+                    f"non-negative send counts; got {rank!r}: {n!r}")
+        for rank, cyc in self.flap.items():
+            ok = (isinstance(cyc, dict)
+                  and isinstance(cyc.get("up"), int) and cyc["up"] >= 1
+                  and isinstance(cyc.get("down"), int) and cyc["down"] >= 1)
+            if not ok:
+                raise ValueError(
+                    "common_args.extra.chaos.flap values must be "
+                    '{"up": >=1, "down": >=1} send-count cycles; got '
+                    f"{rank!r}: {cyc!r}")
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["FaultSpec"]:
+        """Resolve `common_args.extra.chaos` from a Config (None when no
+        plan is set) — the single parse point the simulators share."""
+        raw = cfg.common_args.extra.get("chaos")
+        if not raw:
+            return None
+        return raw if isinstance(raw, cls) else cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        if not isinstance(d, dict):
+            raise ValueError(
+                "common_args.extra.chaos must be a mapping of FaultSpec "
+                f"knobs; got {d!r}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown common_args.extra.chaos keys {unknown} "
+                f"(known: {sorted(known)})")
+        # YAML keys arrive as strings; crash/flap schedules are rank-keyed
+        norm = dict(d)
+        for sched in ("crash", "flap"):
+            if isinstance(norm.get(sched), dict):
+                norm[sched] = {int(k): v for k, v in norm[sched].items()}
+        return cls(**norm)
+
+    def any_link_faults(self) -> bool:
+        return bool(self.crash or self.flap
+                    or any(getattr(self, f) > 0.0 for f in _PROB_FIELDS))
+
+    def any_client_faults(self) -> bool:
+        return self.client_dropout > 0.0 or self.client_straggler > 0.0
+
+    def link_rng(self, src: int, dst: int, seq: int) -> random.Random:
+        """One fresh RNG per (sender, receiver, link-sequence) triple — the
+        determinism backbone: fault draws never depend on wall clock, thread
+        interleaving, or other links' traffic."""
+        key = ((self.seed * 1000003 + src) * 1000003 + dst) * 1000003 + seq
+        return random.Random(key)
+
+    def crashed(self, rank: int, n_sends: int) -> bool:
+        after = self.crash.get(rank)
+        return after is not None and n_sends > after
+
+    def flapped(self, rank: int, n_sends: int) -> bool:
+        cyc = self.flap.get(rank)
+        if cyc is None:
+            return False
+        u, d = int(cyc["up"]), int(cyc["down"])
+        return (n_sends - 1) % (u + d) >= u
+
+
+class ChaosTransport(BaseTransport, Observer):
+    """Fault-injecting wrapper over any BaseTransport.
+
+    Faults act on the SEND path only (the receive path forwards inner
+    notifications unchanged): byte-level faults (corrupt) and out-of-band
+    delivery (delay/duplicate/reorder) go through the inner transport's
+    `_send_raw(frame, receiver_id)` raw-frame hook; a transport without one
+    (the broker's two-plane send) still gets message-level drop/delay/
+    duplicate/reorder, but a spec with corrupt > 0 is rejected at
+    construction rather than silently skipped.
+
+    On its own this wrapper makes runs FAIL — that is the point. Stack
+    `ReliableTransport` (comm/reliable.py) outside it to make the same runs
+    survive: reliable(chaos(transport)) injects faults under the
+    retransmit/dedup machinery, so acks and retransmits face the same
+    weather as data frames.
+    """
+
+    def __init__(self, inner: BaseTransport, spec: FaultSpec):
+        super().__init__()
+        self.inner = inner
+        self.spec = spec
+        self._raw = getattr(inner, "_send_raw", None)
+        if spec.corrupt > 0.0 and self._raw is None:
+            raise ValueError(
+                f"chaos corrupt faults need a raw-frame transport; "
+                f"{type(inner).__name__} has no _send_raw hook")
+        self._lock = threading.Lock()
+        self._sends = 0                      # this rank's outbound total
+        self._link_seq: dict[int, int] = {}  # receiver -> per-link seq
+        self._timers: set[threading.Timer] = set()
+        self._stopped = False
+        inner.add_observer(self)
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def rank(self) -> int:
+        return getattr(self.inner, "rank", 0)
+
+    @property
+    def backend_name(self) -> str:  # metric namespace stays the inner one's
+        return self.inner.backend_name
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        self._notify(msg)        # inner -> our observers, unchanged
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self._stopped = True
+        with self._lock:
+            timers, self._timers = list(self._timers), set()
+        for t in timers:
+            t.cancel()
+        self.inner.stop_receive_message()
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "inner"), item)
+
+    # -------------------------------------------------------------- faults
+    def _count(self, kind: str, msg: Message, seq: int) -> None:
+        _mx.inc(f"fed.chaos.{kind}")
+        # zero-duration span: the fault lands on the Chrome trace's comm
+        # track, time-aligned with the sends it perturbed, and searchable
+        with recorder.span(f"comm.chaos.{kind}", sender=msg.sender_id,
+                           receiver=msg.receiver_id, seq=seq,
+                           msg_type=msg.type):
+            pass
+
+    @staticmethod
+    def _corrupt_frame(frame: bytes, rng: random.Random) -> bytes:
+        """Tamper one byte of the JSON header region: rejected by the CRC
+        trailer when the native tier is present, and by the UTF-8/JSON parse
+        when it is not — detection never depends on optional native code."""
+        ba = bytearray(frame)
+        if len(ba) <= 8:
+            return bytes(ba)
+        (hlen,) = struct.unpack("<I", bytes(ba[4:8]))
+        lo, hi = 8, min(8 + max(hlen, 1), len(ba))
+        i = lo + rng.randrange(max(hi - lo, 1))
+        ba[i] ^= 0xFF
+        return bytes(ba)
+
+    def _deliver(self, fn, delay_s: float) -> None:
+        """Run `fn` now or after `delay_s` on a daemon timer; late timers
+        firing into a stopped/closed inner transport are swallowed."""
+
+        def guarded():
+            if self._stopped:
+                return
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — injected-latency path
+                log.debug("chaos delayed delivery failed: %s: %s",
+                          type(e).__name__, e)
+
+        if delay_s <= 0.0:
+            guarded()
+            return
+
+        def fire():
+            with self._lock:
+                self._timers.discard(t)
+            guarded()
+
+        t = threading.Timer(delay_s, fire)
+        t.daemon = True
+        with self._lock:
+            self._timers.add(t)
+        t.start()
+
+    def send_message(self, msg: Message) -> None:
+        spec = self.spec
+        dst = msg.receiver_id
+        with self._lock:
+            self._sends += 1
+            n = self._sends
+            seq = self._link_seq[dst] = self._link_seq.get(dst, 0) + 1
+        if spec.crashed(self.rank, n):
+            self._count("crash_drops", msg, seq)
+            return
+        if spec.flapped(self.rank, n):
+            self._count("flap_drops", msg, seq)
+            return
+        rng = spec.link_rng(self.rank, dst, seq)
+        # fixed draw order — determinism contract: drop, duplicate, corrupt,
+        # delay, reorder (changing this order silently reshuffles every
+        # seeded plan; tests/test_chaos.py pins seeds against it)
+        if rng.random() < spec.drop:
+            self._count("drop", msg, seq)
+            return
+        dup = rng.random() < spec.duplicate
+        corrupt = rng.random() < spec.corrupt
+        delay_s = 0.0
+        if rng.random() < spec.delay:
+            delay_s = rng.random() * spec.delay_max_s
+            self._count("delay", msg, seq)
+        if rng.random() < spec.reorder:
+            # an extra hold long enough that in-flight later sends pass it
+            delay_s += (0.5 + 0.5 * rng.random()) * max(spec.delay_max_s, 0.01)
+            self._count("reorder", msg, seq)
+        if dup:
+            self._count("duplicate", msg, seq)
+        if corrupt:
+            self._count("corrupt", msg, seq)
+
+        if self._raw is not None:
+            frame = self.inner._encode_frame(msg)
+            wire = self._corrupt_frame(frame, rng) if corrupt else frame
+            self._deliver(lambda: self._raw(wire, dst), delay_s)
+            if dup:
+                # the duplicate is the CLEAN frame: a dup of a corrupt frame
+                # would just be rejected twice and test nothing
+                self._deliver(lambda: self._raw(frame, dst), delay_s)
+        else:
+            self._deliver(lambda: self.inner.send_message(msg), delay_s)
+            if dup:
+                self._deliver(lambda: self.inner.send_message(msg), delay_s)

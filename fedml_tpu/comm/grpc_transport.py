@@ -50,11 +50,28 @@ class GrpcTransport(BaseTransport):
 
     def __init__(self, rank: int, ip_table: dict[int, str],
                  port: Optional[int] = None, max_workers: int = 4,
-                 max_message_mb: int = 512):
+                 max_message_mb: int = 512,
+                 rpc_timeout_s: Optional[float] = 30.0,
+                 send_retries: int = 2, retry_backoff_s: float = 0.1):
+        """rpc_timeout_s: per-RPC deadline (ISSUE 4) — a black-holed peer
+        fails the send with DEADLINE_EXCEEDED instead of hanging a round
+        forever; None restores the unbounded legacy behavior. The default
+        comes from `common_args.extra.comm_retry.rpc_timeout_s` when the
+        transport is built through `create_transport`.
+        send_retries: connection-level retries (UNAVAILABLE only — the peer
+        was provably never reached, so a resend cannot duplicate); the
+        channel is rebuilt before each retry so a restarted peer is picked
+        up. Deadline expiries are NOT retried here: the request may have
+        been delivered with only the response lost, and only the reliable
+        layer's dedup (comm/reliable.py) makes that resend safe."""
         super().__init__()
         self.rank = rank
         self.ip_table = dict(ip_table)
         self.port = port if port is not None else BASE_PORT + rank
+        self.rpc_timeout_s = rpc_timeout_s
+        self.send_retries = int(send_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._ch_lock = threading.Lock()
         self._inbox: queue.Queue = queue.Queue()
         self._running = False
         opts = [
@@ -88,20 +105,49 @@ class GrpcTransport(BaseTransport):
         self._channels: dict[int, grpc.Channel] = {}
 
     def _stub(self, rank: int):
-        if rank not in self._channels:
-            self._channels[rank] = grpc.insecure_channel(
-                self.ip_table[rank], options=self._opts
-            )
-        return self._channels[rank].unary_unary(
+        with self._ch_lock:
+            if rank not in self._channels:
+                self._channels[rank] = grpc.insecure_channel(
+                    self.ip_table[rank], options=self._opts
+                )
+            ch = self._channels[rank]
+        return ch.unary_unary(
             _FULL_METHOD, request_serializer=None, response_deserializer=None
         )
 
+    def _drop_channel(self, rank: int) -> None:
+        with self._ch_lock:
+            ch = self._channels.pop(rank, None)
+        if ch is not None:
+            ch.close()
+
     def send_message(self, msg: Message) -> None:
         frame = self._encode_frame(msg)
+        self._send_raw(frame, msg.receiver_id)
+
+    def _send_raw(self, frame: bytes, receiver_id: int) -> None:
         # publish latency here is the blocking unary RPC — wire + remote
         # handler enqueue, the comm study's transport-level latency term
         t0 = time.perf_counter()
-        self._stub(msg.receiver_id)(frame)
+        attempt = 0
+        while True:
+            try:
+                self._stub(receiver_id)(frame, timeout=self.rpc_timeout_s)
+                break
+            except grpc.RpcError as e:
+                _mx.inc("comm.grpc.send_errors")
+                code = e.code() if hasattr(e, "code") else None
+                if (code == grpc.StatusCode.UNAVAILABLE
+                        and attempt < self.send_retries):
+                    # reconnect-on-UNAVAILABLE: a dead subchannel stays dead
+                    # until rebuilt; a restarted peer needs a fresh channel
+                    attempt += 1
+                    _mx.inc("comm.grpc.reconnects")
+                    _mx.inc("comm.grpc.send_retries")
+                    self._drop_channel(receiver_id)
+                    time.sleep(self.retry_backoff_s * attempt)
+                    continue
+                raise
         _mx.observe("comm.grpc.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:
@@ -113,7 +159,7 @@ class GrpcTransport(BaseTransport):
                 continue
             if frame is None:
                 break
-            self._notify(self._decode_frame(frame))
+            self._notify_frame(frame)
 
     def stop_receive_message(self) -> None:
         self.shutdown(grace=1.0)
@@ -127,6 +173,7 @@ class GrpcTransport(BaseTransport):
         self._running = False
         self._inbox.put(None)
         self._server.stop(grace=grace).wait(timeout=2.0)
-        for ch in self._channels.values():
+        with self._ch_lock:
+            channels, self._channels = list(self._channels.values()), {}
+        for ch in channels:
             ch.close()
-        self._channels.clear()

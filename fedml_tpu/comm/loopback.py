@@ -61,8 +61,13 @@ class LoopbackTransport(BaseTransport):
 
     def send_message(self, msg: Message) -> None:
         frame = self._encode_frame(msg)  # exercise the wire format in-process
+        self._send_raw(frame, msg.receiver_id)
+
+    def _send_raw(self, frame: bytes, receiver_id: int) -> None:
+        """Raw-frame enqueue — the chaos plane's injection point (comm/
+        chaos.py delivers tampered/duplicated/delayed frames through here)."""
         t0 = time.perf_counter()
-        self.router.mailbox(msg.receiver_id).put(frame)
+        self.router.mailbox(receiver_id).put(frame)
         _mx.observe("comm.loopback.publish_s", time.perf_counter() - t0)
 
     def handle_receive_message(self) -> None:
@@ -71,7 +76,7 @@ class LoopbackTransport(BaseTransport):
             item = self._inbox.get()
             if item is self._STOP:
                 break
-            self._notify(self._decode_frame(item))
+            self._notify_frame(item)
 
     def stop_receive_message(self) -> None:
         self._running = False

@@ -16,6 +16,7 @@ MPI/gRPC/TRPC/MQTT_S3/...). Here the backend menu is:
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
@@ -33,6 +34,7 @@ class FedCommManager(Observer):
         self._handlers: dict[str, Callable[[Message], None]] = {}
         self.transport.add_observer(self)
         self._thread: Optional[threading.Thread] = None
+        self._warned_unhandled: set[str] = set()
 
     # reference API (fedml_comm_manager.py:63)
     def register_message_receive_handler(
@@ -53,10 +55,19 @@ class FedCommManager(Observer):
     def receive_message(self, msg_type: str, msg: Message) -> None:
         handler = self._handlers.get(msg_type)
         if handler is None:
-            raise KeyError(
-                f"rank {self.rank}: no handler registered for {msg_type!r} "
-                f"(registered: {sorted(self._handlers)})"
-            )
+            # an unknown type used to raise on the background receive loop,
+            # silently killing ALL message delivery (ISSUE 4): a peer one
+            # protocol version ahead could take down this process's comm.
+            # Log once per type, count every occurrence, keep the loop.
+            _mx.inc("comm.msgs_unhandled")
+            if msg_type not in self._warned_unhandled:
+                self._warned_unhandled.add(msg_type)
+                logging.getLogger(__name__).warning(
+                    "rank %d: no handler registered for %r (registered: %s) "
+                    "— dropping; further occurrences counted in "
+                    "comm.msgs_unhandled", self.rank, msg_type,
+                    sorted(self._handlers))
+            return
         tid, parent = msg.trace_context()
         _mx.inc("comm.msgs_handled")
         with trace_context(tid, parent):
@@ -84,12 +95,45 @@ class FedCommManager(Observer):
             self._thread.join(timeout=5)
 
 
+def _wrap_transport(t: BaseTransport, chaos, retry_policy) -> BaseTransport:
+    """Apply the robustness stack (ISSUE 4): chaos INSIDE, reliability
+    OUTSIDE — injected faults hit data frames, acks, and retransmits alike,
+    and the retry/dedup machinery is what recovers from them."""
+    if chaos is not None:
+        from .chaos import ChaosTransport, FaultSpec
+
+        spec = chaos if isinstance(chaos, FaultSpec) \
+            else FaultSpec.from_dict(chaos)
+        if spec.any_link_faults():
+            t = ChaosTransport(t, spec)
+    if retry_policy is not None:
+        from .reliable import ReliableTransport
+
+        t = ReliableTransport(t, retry_policy)
+    return t
+
+
 def create_transport(backend: str, rank: int, run_id: str = "default",
-                     ip_table: Optional[dict] = None, **kw) -> BaseTransport:
-    """Backend factory (reference: _init_manager, fedml_comm_manager.py:131)."""
+                     ip_table: Optional[dict] = None, chaos=None,
+                     comm_retry=None, **kw) -> BaseTransport:
+    """Backend factory (reference: _init_manager, fedml_comm_manager.py:131).
+
+    chaos: FaultSpec or `common_args.extra.chaos` dict — wraps the transport
+    in a fault-injecting ChaosTransport (comm/chaos.py).
+    comm_retry: RetryPolicy, `common_args.extra.comm_retry` dict, or True
+    for defaults — wraps the stack in a ReliableTransport (seq/ack/
+    retransmit/dedup, comm/reliable.py); for grpc it also supplies the
+    default per-RPC deadline.
+    """
+    policy = None
+    if comm_retry is not None and comm_retry is not False:
+        from .reliable import RetryPolicy
+
+        policy = comm_retry if isinstance(comm_retry, RetryPolicy) \
+            else RetryPolicy.from_dict(comm_retry)
     b = (backend or "loopback").lower()
     if b == "loopback":
-        return LoopbackTransport(rank, run_id)
+        return _wrap_transport(LoopbackTransport(rank, run_id), chaos, policy)
     if b == "grpc":
         from .grpc_transport import GrpcTransport, load_ip_table
         if ip_table is None:
@@ -97,7 +141,10 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
                              "or a csv path (reference: grpc_ipconfig.csv)")
         if isinstance(ip_table, str):
             ip_table = load_ip_table(ip_table)
-        return GrpcTransport(rank, ip_table, **kw)
+        if policy is not None:
+            kw.setdefault("rpc_timeout_s", policy.rpc_timeout_s)
+        return _wrap_transport(GrpcTransport(rank, ip_table, **kw),
+                               chaos, policy)
     if b == "xla":
         raise ValueError(
             "backend='xla' is the in-program collective path (simulation over "
@@ -109,7 +156,8 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
         # side-channel (comm/broker.py; reference MQTT+S3 shape)
         from .broker import BrokerTransport
 
-        return BrokerTransport(rank, run_id, **kw)
+        return _wrap_transport(BrokerTransport(rank, run_id, **kw),
+                               chaos, policy)
     if b in ("mqtt_web3", "mqtt_thetastore", "web3"):
         # decentralized-storage shape: content-addressed, hash-verified,
         # deduplicating blob plane (reference: mqtt_web3/ + mqtt_thetastore/
@@ -118,7 +166,8 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
 
         if "broker" not in kw:
             kw["broker"] = get_cas_broker(run_id)
-        return BrokerTransport(rank, run_id, **kw)
+        return _wrap_transport(BrokerTransport(rank, run_id, **kw),
+                               chaos, policy)
     if b in ("trpc", "mpi"):
         raise ValueError(
             f"backend {b!r} is a reference transport not provided in the TPU "

@@ -296,6 +296,19 @@ class Config:
                 raise ValueError(
                     "common_args.extra.metrics_port must be an integer in "
                     f"[0, 65535] (0 = ephemeral); got {mp!r}")
+        # chaos plane + reliable delivery knobs (ISSUE 4): both specs are
+        # parsed by their owning modules so validation never drifts from the
+        # consumer; lazy imports keep config load jax-free and cycle-free.
+        chaos = self.common_args.extra.get("chaos")
+        if chaos is not None:
+            from .comm.chaos import FaultSpec
+
+            FaultSpec.from_dict(chaos)
+        cr = self.common_args.extra.get("comm_retry")
+        if cr not in (None, False):
+            from .comm.reliable import RetryPolicy
+
+            RetryPolicy.from_dict(cr)
         if self.common_args.training_type not in (
             TRAINING_TYPE_SIMULATION,
             TRAINING_TYPE_CROSS_SILO,

@@ -113,6 +113,8 @@ def _make_round_body(
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
     health_stats: bool = False,
+    client_dropout: float = 0.0,
+    client_straggler: float = 0.0,
 ) -> Callable:
     """Build the traceable round body shared by `build_round_fn` (one round
     per jit call) and `build_block_fn` (K rounds scanned inside one jit).
@@ -149,6 +151,23 @@ def _make_round_body(
     the same device→host transfer as the scalar metrics. Mesh-padding
     duplicate rows are included (the host masks them by weight). Health
     stats are observation-only: they change no training output.
+    client_dropout / client_straggler: chaos-plane client-fault rates
+    (ISSUE 4, `common_args.extra.chaos`). Seeded per-round masks are drawn
+    IN-JIT from the round rng (so blocked and per-round execution draw
+    bit-identical masks) and keyed by client id (so a mesh-padding
+    duplicate shares its source's fate). A faulted client still computes —
+    shapes stay static — but its aggregation weight is zeroed, so every
+    weight-driven aggregate (the weighted-mean paths and the default FULL
+    hook) reweights over the survivors without a host round-trip, its
+    training metrics are excluded, and its persistent client state keeps
+    the pre-round value (a lost report never happened). Weight-IGNORING
+    full-set aggregators get the survivor mask as ctx["fault_keep"] and
+    must honor it themselves (static shapes cannot shrink the cohort).
+    A round where EVERY sampled client faults degrades to a zero aggregate
+    — a no-op server step for delta-style algorithms — rather than a NaN.
+    The drawn masks ride the metrics dict as `metrics["faults"]`
+    ({"dropped", "straggled"}: [m] f32 0/1) so the host health plane can
+    account participation and flag the injected faults.
     """
     use_full = aggregate_full is not None or alg.agg_mode == FULL
     if use_full and aggregate_full is None:
@@ -195,7 +214,7 @@ def _make_round_body(
         )
 
     def finalize(server_state, agg, mets: ClientMetrics, new_states_full,
-                 hook_state, health=None):
+                 hook_state, health=None, faults=None):
         new_server = alg.server_update(server_state, agg)
         n = jnp.maximum(mets.count, 1.0)
         metrics = {
@@ -205,6 +224,8 @@ def _make_round_body(
         }
         if health:
             metrics["health"] = health
+        if faults:
+            metrics["faults"] = faults
         return RoundOutput(new_server, new_states_full, metrics, hook_state)
 
     def round_body(server_state, full_cstates, data, ids, weights, rng, hook_state):
@@ -222,8 +243,44 @@ def _make_round_body(
         )
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
         agg_rng = jax.random.fold_in(rng, 0x5EC)
+
+        # ------------------------- chaos plane: in-jit client-fault masks
+        faults = None
+        keep = None
+        if client_dropout > 0.0 or client_straggler > 0.0:
+            frng = jax.random.fold_in(rng, 0xFA17)
+
+            def fault_mask(rate, salt):
+                if rate <= 0.0:
+                    return jnp.zeros(ids.shape, bool)
+                r = jax.random.fold_in(frng, salt)
+                return jax.vmap(lambda i: jax.random.bernoulli(
+                    jax.random.fold_in(r, i), rate))(ids)
+
+            dropped = fault_mask(client_dropout, 1)
+            # a crashed client can't also straggle; keep the masks disjoint
+            straggled = jnp.logical_and(fault_mask(client_straggler, 2),
+                                        jnp.logical_not(dropped))
+            keep = jnp.logical_not(jnp.logical_or(dropped, straggled))
+            # zeroed weight = lost report on every WEIGHT-DRIVEN aggregate
+            # (the weighted-mean paths and the default FULL hook): the
+            # aggregate renormalizes over survivors and faulted clients'
+            # metrics are masked out in run_clients — no host round-trip,
+            # no shape change. Weight-IGNORING full-set aggregators
+            # (coordinate median, krum selection, ...) cannot shrink their
+            # static-shape cohort this way; they receive the mask as
+            # ctx["fault_keep"] below and must exclude faulted rows
+            # themselves — until they do, a faulted client's update still
+            # influences such statistics.
+            weights = weights * keep.astype(weights.dtype)
+            faults = {"dropped": dropped.astype(jnp.float32),
+                      "straggled": straggled.astype(jnp.float32)}
         ctx = {"rng": agg_rng, "ids": ids, "state": hook_state,
                "params": server_state.params}
+        if keep is not None:
+            # FULL-mode hooks that ignore weights (median/krum families)
+            # need the survivor mask explicitly — see the note above
+            ctx["fault_keep"] = keep
 
         def call_full(upds, w):
             mr = num_real_clients
@@ -231,6 +288,8 @@ def _make_round_body(
                 upds = jax.tree.map(lambda a: a[:mr], upds)
                 w = w[:mr]
                 cx = {**ctx, "ids": ids[:mr]}
+                if keep is not None:
+                    cx["fault_keep"] = keep[:mr]
             else:
                 cx = ctx
             return aggregate_full(upds, w, cx)
@@ -321,11 +380,20 @@ def _make_round_body(
         if postprocess_agg is not None:
             agg = postprocess_agg(agg, ctx)
         if has_cstate:
+            if keep is not None:
+                # a faulted client's report was lost: its persistent state
+                # (SCAFFOLD c_i, FedDyn h_i, ...) must keep the pre-round
+                # value, exactly as if it had never been dispatched
+                nstates = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        keep.reshape((-1,) + (1,) * (new.ndim - 1)),
+                        new, old),
+                    nstates, cstates)
             full_cstates = jax.tree.map(
                 lambda full, new: full.at[ids].set(new), full_cstates, nstates
             )
         return finalize(server_state, agg, summed, full_cstates, hook_state,
-                        health)
+                        health, faults)
 
     return round_body
 
@@ -340,12 +408,15 @@ def build_round_fn(
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
     health_stats: bool = False,
+    client_dropout: float = 0.0,
+    client_straggler: float = 0.0,
 ) -> Callable:
     """Build the jitted single-round function (see `_make_round_body` for the
     argument contract)."""
     round_body = _make_round_body(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
         postprocess_agg, num_real_clients, health_stats,
+        client_dropout, client_straggler,
     )
     # donate server/client/hook state: all three are dead after the call, and
     # the hook state can be a [N, D] defense history that must update in place.
@@ -365,6 +436,8 @@ def build_block_fn(
     postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
     num_real_clients: Optional[int] = None,
     health_stats: bool = False,
+    client_dropout: float = 0.0,
+    client_straggler: float = 0.0,
 ) -> Callable:
     """Build the jitted ROUND-BLOCK function: K federated rounds as one XLA
     program, `lax.scan` over the exact same round body `build_round_fn` jits.
@@ -387,6 +460,7 @@ def build_block_fn(
     round_body = _make_round_body(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
         postprocess_agg, num_real_clients, health_stats,
+        client_dropout, client_straggler,
     )
 
     def block_body(server_state, full_cstates, data, ids, weights, base_rng,

@@ -105,13 +105,17 @@ class FedMLRunner:
         backend = transport or cfg.comm_args.extra.get("transport", "loopback")
         ip_table = cfg.comm_args.grpc_ipconfig_path or None
         run_id = cfg.comm_args.extra.get("run_id", "cs")
+        # robustness stack (ISSUE 4): chaos injection + reliable delivery
+        # ride the same config keys every runtime reads
+        rel = dict(chaos=cfg.common_args.extra.get("chaos"),
+                   comm_retry=cfg.common_args.extra.get("comm_retry"))
         if backend == "grpc":
-            tr = create_transport(backend, rank, ip_table=ip_table)
+            tr = create_transport(backend, rank, ip_table=ip_table, **rel)
         else:
             # loopback AND broker are namespaced by run_id — the broker is
             # store-and-forward, so sharing a default namespace would leak
             # one run's frames into the next
-            tr = create_transport(backend, rank, run_id=run_id)
+            tr = create_transport(backend, rank, run_id=run_id, **rel)
         comm = FedCommManager(tr, rank)
         secagg = bool(t.extra.get("secagg"))
         client_ids = list(range(1, t.client_num_in_total + 1))
@@ -176,6 +180,8 @@ class FedMLRunner:
         tr = create_transport(
             backend, rank,
             run_id=cfg.comm_args.extra.get("run_id", "cd"),
+            chaos=cfg.common_args.extra.get("chaos"),
+            comm_retry=cfg.common_args.extra.get("comm_retry"),
             **({} if backend == "loopback" else
                {"ip_table": cfg.comm_args.grpc_ipconfig_path or None}))
         comm = FedCommManager(tr, rank)

@@ -78,6 +78,17 @@ class AsyncSimulator:
         self.staleness_mode = str(t.extra.get("async_staleness", "polynomial"))
         self.poly_a = float(t.extra.get("async_poly_a", 0.5))
         spread = float(t.extra.get("async_speed_spread", 1.0))
+        # chaos plane (ISSUE 4): the async loop is host-driven, so client
+        # faults inject at the event queue — a dropout's completion event is
+        # discarded un-merged (the client "crashed" mid-round), a straggler
+        # trains at a fraction of its speed (merges late, at higher
+        # staleness). Draws come from a DEDICATED seeded stream so a
+        # chaos-off run's sampling is untouched.
+        from ..comm.chaos import FaultSpec
+
+        self.fault_spec = FaultSpec.from_config(cfg)
+        self.straggler_factor = float(t.extra.get("chaos_straggler_factor",
+                                                  4.0))
         # live scrape surface (common_args.extra.metrics_port) — the async
         # loop's staleness/participation instruments feed `fedml_tpu top`
         from ..utils.prometheus import maybe_start_metrics_server
@@ -142,6 +153,10 @@ class AsyncSimulator:
                  else t.comm_round * t.client_num_per_round)
         rs = np.random.RandomState(self.cfg.common_args.random_seed + 1)
         base_rng = jax.random.key(self.cfg.common_args.random_seed)
+        spec = self.fault_spec
+        rs_fault = np.random.RandomState(
+            ((spec.seed if spec else 0)
+             + self.cfg.common_args.random_seed + 0xFA17) % (2 ** 31))
 
         # (finish_time, seq, client_id, start_version, params_snapshot)
         heap: list = []
@@ -152,6 +167,10 @@ class AsyncSimulator:
             cid = self._sample_client(rs)
             dur = self.client_time[cid] * max(
                 float(self.dataset.counts[cid]), 1.0)
+            if spec is not None and spec.client_straggler > 0.0 \
+                    and rs_fault.rand() < spec.client_straggler:
+                dur *= self.straggler_factor
+                _mx.inc("fed.chaos.client_stragglers")
             heapq.heappush(heap, (now + dur, seq, cid, self.version, self.params))
             seq += 1
 
@@ -163,6 +182,15 @@ class AsyncSimulator:
         with recorder.span("async_run"):
             while merged < total:
                 finish, s, cid, v0, snap = heapq.heappop(heap)
+                if spec is not None and spec.client_dropout > 0.0 \
+                        and rs_fault.rand() < spec.client_dropout:
+                    # the client crashed mid-round: its completion never
+                    # merges and never counts as participation — async
+                    # dropout tolerance means the loop just keeps going
+                    _mx.inc("fed.chaos.client_dropouts")
+                    if merged + len(heap) < total:
+                        launch(finish)
+                    continue
                 rng_ = jax.random.fold_in(base_rng, s)
                 client_p, met = self._train_one(snap, cid, rng_)
                 tau = self.version - v0

@@ -164,6 +164,13 @@ class Simulator:
         from ..utils.prometheus import maybe_start_metrics_server
 
         self.metrics_exporter = maybe_start_metrics_server(cfg)
+        # chaos plane (ISSUE 4): seeded client-fault injection runs INSIDE
+        # the round/block programs (parallel/round.py) so the aggregate
+        # reweights over survivors with no host round-trip; the spec is the
+        # same one the comm stack's ChaosTransport consumes
+        from ..comm.chaos import FaultSpec
+
+        self.fault_spec = FaultSpec.from_config(cfg)
         # one kwargs dict drives BOTH engines: the per-round program and the
         # K-round scanned block program trace the identical round body
         self._round_kwargs = dict(
@@ -172,6 +179,10 @@ class Simulator:
             postprocess_agg=post_agg,
             num_real_clients=t.client_num_per_round,
             health_stats=self._health_enabled,
+            client_dropout=(self.fault_spec.client_dropout
+                            if self.fault_spec else 0.0),
+            client_straggler=(self.fault_spec.client_straggler
+                              if self.fault_spec else 0.0),
         )
         self.round_fn = build_round_fn(self.alg, **self._round_kwargs)
         self.block_fn = None   # built lazily on the first blocked dispatch
@@ -319,12 +330,14 @@ class Simulator:
         # the per-client health arrays rode the SAME transfer as the scalar
         # metrics; peel them off before the history row is float-mapped
         health = fetched.pop("health", None)
+        faults = fetched.pop("faults", None)
         metrics = jax.tree.map(float, fetched)
         self.server_state = out.server_state
         self.client_states = out.client_states
         self.hook_state = out.hook_state
         self.health.observe_round(round_idx, ids, weights, health,
-                                  duration_s=time.perf_counter() - t0)
+                                  duration_s=time.perf_counter() - t0,
+                                  faults=faults)
         self.dp.step_round()
         if self.dp.enabled and self.dp.accountant is not None:
             metrics["dp_epsilon"] = self.dp.get_epsilon()
@@ -467,15 +480,18 @@ class Simulator:
         # tracker one round at a time (same cadence as per-round mode, with
         # the block's wall time amortized for straggler detection)
         health = m.pop("health", None)
+        faults = m.pop("faults", None)
         recorder.log_block_span("train", blk, block_s)
         for j, r in enumerate(blk):
             row = {"round": r}
             row.update({k: float(v[j]) for k, v in m.items()})
             h_j = ({k: v[j] for k, v in health.items()}
                    if health is not None else None)
+            f_j = ({k: v[j] for k, v in faults.items()}
+                   if faults is not None else None)
             self.health.observe_round(
                 r, ids[j], weights[j], h_j,
-                duration_s=block_s / max(len(blk), 1))
+                duration_s=block_s / max(len(blk), 1), faults=f_j)
             self.dp.step_round()
             if self.dp.enabled and self.dp.accountant is not None:
                 row["dp_epsilon"] = self.dp.get_epsilon()

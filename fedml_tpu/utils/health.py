@@ -148,27 +148,59 @@ class HealthTracker:
 
     def observe_round(self, round_idx: int, ids, weights,
                       health: Optional[dict],
-                      duration_s: Optional[float] = None) -> dict:
+                      duration_s: Optional[float] = None,
+                      faults: Optional[dict] = None) -> dict:
         ids = np.asarray(ids)
         weights = np.asarray(weights)
         real = weights > 0          # mesh-padding duplicates carry weight 0
         mx.set_gauge("fed.round", float(round_idx))
         mx.inc("fed.rounds_total")
-        for cid in ids[real]:
+
+        # chaos plane (ISSUE 4): `faults` is the in-jit fault-mask dict the
+        # round program shipped with its metrics ({"dropped"/"straggled"}:
+        # [m] 0/1). The HOST weights row is pre-mask — the device zeroed its
+        # own copy — so these arrays are how the host learns whose report
+        # was injected away. Faulted clients don't count as participants,
+        # their stats leave the anomaly pools (their update never landed in
+        # the aggregate), and each injected fault raises a flag so the
+        # chaos run is visibly caught by the same surfaces as organic
+        # anomalies (counters + recorder rows + Chrome-trace spans).
+        injected: list[dict] = []
+        participated = real
+        if faults is not None:
+            z = np.zeros(len(ids))
+            dropped = np.asarray(faults.get("dropped", z)) > 0.5
+            straggled = np.asarray(faults.get("straggled", z)) > 0.5
+            nd = int(np.sum(dropped & real))
+            ns = int(np.sum(straggled & real))
+            if nd:
+                mx.inc("fed.chaos.client_dropouts", nd)
+            if ns:
+                mx.inc("fed.chaos.client_stragglers", ns)
+            for cid in ids[dropped & real]:
+                injected.append({"client": int(cid),
+                                 "reasons": ["injected_dropout"]})
+            for cid in ids[straggled & real]:
+                injected.append({"client": int(cid),
+                                 "reasons": ["injected_straggler"]})
+            participated = real & ~dropped & ~straggled
+        for cid in ids[participated]:
             record_participation(cid)
 
         flags: list[dict] = []
         if health is not None:
-            norms = np.asarray(health["update_norm"], np.float64)[real]
-            cosines = np.asarray(health["cosine"], np.float64)[real]
+            norms = np.asarray(health["update_norm"],
+                               np.float64)[participated]
+            cosines = np.asarray(health["cosine"], np.float64)[participated]
             mx.set_gauge("fed.health.update_norm_median",
                          float(np.median(norms)) if norms.size else 0.0)
             mx.set_gauge("fed.health.cosine_min",
                          float(cosines.min()) if cosines.size else 0.0)
             if self.rounds_seen >= self.warmup_rounds:
-                flags = self._flag_clients(ids[real], norms, cosines)
+                flags = self._flag_clients(ids[participated], norms, cosines)
             self._norms.append(norms)
             self._cosines.append(cosines)
+        flags = flags + injected   # injected faults ride the flag surface
 
         straggler = False
         if duration_s is not None:

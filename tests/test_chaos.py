@@ -1,0 +1,550 @@
+"""Chaos plane + reliable delivery (ISSUE 4).
+
+Acceptance pins:
+- under a seeded chaos plan (drop=0.1, dup=0.05, delay<=100ms) a 2-rank
+  cross-silo run completes every round with final global params BITWISE
+  identical to the fault-free run; the same plan with reliability disabled
+  demonstrably fails (the sync FSM stalls on the first lost frame);
+- the receiver-side dedup window makes retransmits/duplicates idempotent;
+- in-jit client dropout/straggler masks keep blocked (rounds_per_block=K)
+  and per-round execution equivalent on all three aggregation paths
+  (no-mesh, LINEAR shard_map, FULL), reweight the aggregate over survivors,
+  and raise the corresponding fed.chaos.* / fed.health.* signals.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.comm import (
+    ChaosTransport, FaultSpec, FedCommManager, Message, ReliableTransport,
+    RetryPolicy, create_transport,
+)
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.config import TrainArgs
+from fedml_tpu.cross_silo import (
+    FedClientManager, FedServerManager, SiloTrainer,
+)
+from fedml_tpu.models import hub
+from fedml_tpu.simulation.simulator import Simulator
+from fedml_tpu.utils import metrics as mx
+
+
+# ------------------------------------------------------------ config plumbing
+def _sim_cfg(backend="sp", chaos=None, extra=None, common_extra=None, **tov):
+    d = {
+        "common_args": {"training_type": "simulation", "random_seed": 0,
+                        "extra": {**({"chaos": chaos} if chaos else {}),
+                                  **(common_extra or {})}},
+        "data_args": {"dataset": "synthetic",
+                      "extra": {"synthetic_samples_per_client": 32}},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": "FedAvg",
+            "client_num_in_total": 8, "client_num_per_round": 5,
+            "comm_round": 8, "epochs": 1, "batch_size": 8,
+            "learning_rate": 0.1,
+            **(dict(extra=extra) if extra else {}), **tov,
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": backend},
+    }
+    return fedml_tpu.init(config=d)
+
+
+def test_chaos_and_retry_knobs_validated_at_config_load():
+    """A typo'd fault plan or retry budget fails at init, not mid-run."""
+    for bad in ({"drop": 1.5}, {"drop": "lots"}, {"bogus_knob": 1},
+                {"delay_max_s": -1}, {"crash": {"0": -2}},
+                {"flap": {"1": {"up": 0, "down": 3}}}):
+        with pytest.raises(ValueError, match="chaos"):
+            _sim_cfg(chaos=bad)
+    for bad in ({"max_attempts": 0}, {"jitter": 2.0}, {"ack_timeout_s": 0},
+                {"unknown": 1}):
+        with pytest.raises(ValueError, match="comm_retry"):
+            _sim_cfg(common_extra={"comm_retry": bad})
+    # good plans load (and `comm_retry: true` means defaults)
+    _sim_cfg(chaos={"seed": 3, "drop": 0.1, "duplicate": 0.05,
+                    "client_dropout": 0.2, "crash": {"1": 20},
+                    "flap": {"2": {"up": 5, "down": 2}}},
+             common_extra={"comm_retry": True})
+
+
+# ------------------------------------------------------------- link faults
+def test_chaos_drop_is_deterministic_and_counted():
+    spec = FaultSpec(seed=11, drop=1.0)
+    run = "chaos-drop"
+    a = ChaosTransport(LoopbackTransport(0, run), spec)
+    b = FedCommManager(LoopbackTransport(1, run), 1)
+    got = []
+    b.register_message_receive_handler("x", lambda m: got.append(m))
+    b.run(background=True)
+    base = mx.snapshot()["counters"].get("fed.chaos.drop", 0)
+    for i in range(5):
+        a.send_message(Message("x", 0, 1).add("i", i))
+    time.sleep(0.2)
+    b.stop()
+    release_router(run)
+    assert got == []
+    assert mx.snapshot()["counters"]["fed.chaos.drop"] - base == 5
+    # the faults landed on the trace as zero-duration comm spans
+    from fedml_tpu.utils.events import recorder
+
+    assert recorder.summary().get("comm.chaos.drop", {}).get("count", 0) >= 5
+    # and the same (seed, link, seq) draws replay identically
+    assert [spec.link_rng(0, 1, s).random() for s in range(1, 6)] == \
+           [spec.link_rng(0, 1, s).random() for s in range(1, 6)]
+
+
+def test_crash_and_flap_schedules():
+    run = "chaos-crash"
+    spec = FaultSpec(seed=1, crash={0: 3})
+    a = ChaosTransport(LoopbackTransport(0, run), spec)
+    b = FedCommManager(LoopbackTransport(1, run), 1)
+    got = []
+    b.register_message_receive_handler("x", lambda m: got.append(m.get("i")))
+    b.run(background=True)
+    for i in range(6):
+        a.send_message(Message("x", 0, 1).add("i", i))
+    time.sleep(0.2)
+    b.stop()
+    release_router(run)
+    assert got == [0, 1, 2]     # link went dark after its 3rd send
+    # flap: 2 up / 2 down cycling by send index
+    assert [FaultSpec(flap={5: {"up": 2, "down": 2}}).flapped(5, n)
+            for n in range(1, 7)] == [False, False, True, True, False, False]
+
+
+def _reliable_stack(rank, run_id, spec, policy):
+    return FedCommManager(
+        ReliableTransport(ChaosTransport(LoopbackTransport(rank, run_id),
+                                         spec), policy), rank)
+
+
+def test_reliable_exactly_once_under_chaos():
+    """Drop + duplicate + delay + corrupt, all seeded: every message lands
+    exactly once — dedup prevents double-apply, retransmits cover drops,
+    the wire CRC/parse rejects corruption and retransmit covers that too."""
+    spec = FaultSpec(seed=3, drop=0.2, duplicate=0.2, delay=0.5,
+                     delay_max_s=0.01, corrupt=0.1)
+    policy = RetryPolicy(ack_timeout_s=0.05, max_attempts=12, deadline_s=30.0)
+    run = "rel-chaos"
+    a = _reliable_stack(0, run, spec, policy)
+    b = _reliable_stack(1, run, spec, policy)
+    got = []
+    b.register_message_receive_handler("probe",
+                                       lambda m: got.append(m.get("i")))
+    a.run(background=True)
+    b.run(background=True)
+    n = 30
+    for i in range(n):
+        a.send_message(Message("probe", 0, 1).add("i", i))
+    deadline = time.time() + 25
+    while time.time() < deadline and len(set(got)) < n:
+        time.sleep(0.05)
+    assert a.transport.flush(10), "sender never drained its pending set"
+    time.sleep(0.2)             # let straggling duplicates land
+    a.stop()
+    b.stop()
+    release_router(run)
+    assert sorted(set(got)) == list(range(n))
+    assert len(got) == len(set(got)), "dedup window failed: double-apply"
+    c = mx.snapshot()["counters"]
+    assert c.get("comm.rel.retransmits", 0) > 0     # chaos actually bit
+    assert c.get("fed.chaos.drop", 0) > 0
+    assert a.transport.failed == []
+
+
+def test_dedup_window_prevents_double_apply_of_raw_duplicates():
+    """A retransmitted frame (same seq) delivered straight to the receiver
+    is dropped by the dedup window even with zero chaos in the plan."""
+    run = "rel-dup"
+    policy = RetryPolicy(ack_timeout_s=5.0)   # no retransmit during the test
+    a = FedCommManager(ReliableTransport(LoopbackTransport(0, run), policy), 0)
+    b = FedCommManager(ReliableTransport(LoopbackTransport(1, run), policy), 1)
+    got = []
+    b.register_message_receive_handler("d", lambda m: got.append(m.get("i")))
+    a.run(background=True)
+    b.run(background=True)
+    msg = Message("d", 0, 1).add("i", 7)
+    a.send_message(msg)                       # stamps _rel_seq=1
+    inner = a.transport.inner
+    for _ in range(3):                        # raw re-sends of the SAME frame
+        inner.send_message(msg)
+    time.sleep(0.3)
+    a.stop()
+    b.stop()
+    release_router(run)
+    assert got == [7]
+    assert mx.snapshot()["counters"].get("comm.rel.dedup_dropped", 0) >= 3
+
+
+def test_restarted_sender_is_not_deduped_into_silence():
+    """A sender that restarts mid-run re-mints sequence numbers from 1; the
+    per-incarnation epoch header makes the receiver reset its dedup window
+    instead of swallowing the new messages as duplicates of the old ones."""
+    run = "rel-restart"
+    policy = RetryPolicy(ack_timeout_s=0.1, max_attempts=5, deadline_s=10.0)
+    b = FedCommManager(ReliableTransport(LoopbackTransport(1, run), policy), 1)
+    got = []
+    b.register_message_receive_handler("r", lambda m: got.append(m.get("i")))
+    b.run(background=True)
+    a1 = FedCommManager(ReliableTransport(LoopbackTransport(0, run), policy), 0)
+    a1.run(background=True)                 # consume acks
+    a1.send_message(Message("r", 0, 1).add("i", "first-life"))
+    assert a1.transport.flush(10) and not a1.transport.failed
+    a1.stop()                               # the "crash"
+    a2 = FedCommManager(ReliableTransport(LoopbackTransport(0, run), policy), 0)
+    a2.run(background=True)
+    a2.send_message(Message("r", 0, 1).add("i", "second-life"))  # seq 1 again
+    assert a2.transport.flush(10) and not a2.transport.failed
+    for _ in range(100):
+        if len(got) == 2:
+            break
+        time.sleep(0.02)
+    a2.stop()
+    b.stop()
+    release_router(run)
+    assert got == ["first-life", "second-life"], got
+
+
+def test_reliable_gives_up_loudly_on_a_dead_peer():
+    run = "rel-dead"
+    spec = FaultSpec(seed=0, drop=1.0)        # black hole
+    policy = RetryPolicy(ack_timeout_s=0.02, max_attempts=3, deadline_s=5.0)
+    a = FedCommManager(
+        ReliableTransport(ChaosTransport(LoopbackTransport(0, run), spec),
+                          policy), 0)
+    a.send_message(Message("x", 0, 1))
+    assert a.transport.flush(10)
+    assert len(a.transport.failed) == 1
+    assert a.transport.failed[0]["attempts"] == 3
+    assert mx.snapshot()["counters"].get("comm.rel.delivery_failed") == 1
+    a.transport.stop_receive_message()
+    release_router(run)
+
+
+# ----------------------------------------------------- cross-silo acceptance
+#: the pinned chaos plan from the issue: drop=0.1, dup=0.05, delay <= 100ms.
+#: seed 3 was chosen so the plan provably drops an early FSM-critical frame
+#: (the no-reliability run stalls at round 0); the draws are keyed by
+#: (seed, src, dst, per-link seq) only, so the pick is stable across
+#: machines and reruns.
+CHAOS_PLAN = dict(seed=3, drop=0.1, duplicate=0.05, delay=0.3,
+                  delay_max_s=0.1)
+
+
+def _make_trainer(model, t, seed):
+    rs = np.random.RandomState(seed)
+    n, d = 64, 8
+    w_true = rs.randn(d, 3)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+    return SiloTrainer(model.apply, t, x, y, seed=seed)
+
+
+def _cross_silo_run(run_id, chaos=None, comm_retry=None, rounds=3,
+                    timeout=120):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=2, batch_size=16, learning_rate=0.3,
+                  client_num_in_total=2, client_num_per_round=2,
+                  comm_round=rounds)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    mk = lambda r: FedCommManager(  # noqa: E731
+        create_transport("loopback", r, run_id, chaos=chaos,
+                         comm_retry=comm_retry), r)
+    server = FedServerManager(mk(0), client_ids=[1, 2],
+                              init_params=params_np, num_rounds=rounds)
+    clients = [FedClientManager(mk(cid), cid, _make_trainer(model, t, cid))
+               for cid in (1, 2)]
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+        c.announce_ready()
+    finished = server.done.wait(timeout=timeout)
+    if finished:
+        for c in clients:
+            c.done.wait(timeout=30)
+    else:                       # failure path: tear the FSMs down ourselves
+        server.comm.stop()
+        for c in clients:
+            c.comm.stop()
+    release_router(run_id)
+    return finished, server
+
+
+def test_cross_silo_chaos_with_reliability_bitwise_identical():
+    """The issue's acceptance pin: under the seeded plan every round
+    completes and the final global params are BITWISE identical to the
+    fault-free run — reliability makes chaos invisible to the math."""
+    ok_ref, ref = _cross_silo_run("cs-chaos-ref")
+    assert ok_ref and len(ref.history) == 3
+    ok, srv = _cross_silo_run(
+        "cs-chaos-rel", chaos=CHAOS_PLAN,
+        comm_retry={"ack_timeout_s": 0.15, "max_attempts": 10,
+                    "deadline_s": 30.0})
+    assert ok, "chaos run did not finish despite reliability"
+    assert len(srv.history) == 3
+    assert all(r["n_received"] == 2 for r in srv.history)
+    for a, b in zip(jax.tree.leaves(ref.params), jax.tree.leaves(srv.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "params diverged under chaos + reliability"
+    # the injected weather was real and visible
+    c = mx.snapshot()["counters"]
+    assert sum(v for k, v in c.items() if k.startswith("fed.chaos.")) > 0
+
+
+def test_cross_silo_chaos_without_reliability_fails():
+    """Same plan, reliability off: the sync FSM stalls on the first lost
+    frame — the demonstrable failure the delivery layer exists to fix."""
+    ok, srv = _cross_silo_run("cs-chaos-raw", chaos=CHAOS_PLAN, timeout=8)
+    assert not ok, ("the pinned chaos plan unexpectedly completed without "
+                    "reliability — seed no longer drops a critical frame?")
+    assert len(srv.history) < 3
+
+
+# ------------------------------------------- in-jit client-fault masks
+CLIENT_CHAOS = {"seed": 5, "client_dropout": 0.3, "client_straggler": 0.2}
+
+
+def _assert_histories_match(h_ref, h_blk):
+    assert len(h_ref) == len(h_blk)
+    for a, b in zip(h_ref, h_blk):
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(
+                a[k], b[k], rtol=2e-5, atol=1e-6,
+                err_msg=f"history[{a['round']}][{k}] diverged")
+
+
+def _assert_trees_match(t_ref, t_blk, rtol=2e-5, atol=1e-6):
+    for a, b in zip(jax.tree.leaves(jax.device_get(t_ref)),
+                    jax.tree.leaves(jax.device_get(t_blk))):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("backend,tov", [
+    ("sp", {}),                                        # no-mesh path
+    ("xla", {}),                                       # LINEAR shard_map
+    ("xla", {"security_args": True}),                  # FULL (wise_median)
+])
+def test_dropout_mask_block_equivalence_all_paths(backend, tov):
+    """Blocked K=4 and per-round execution stay equivalent with seeded
+    dropout/straggler masks on: the masks derive from the round rng, so the
+    scanned block draws bit-identical faults."""
+    sec = {"security_args": {"enable_defense": True,
+                             "defense_type": "wise_median"}} \
+        if tov.pop("security_args", False) else {}
+
+    def build(extra=None):
+        cfg = _sim_cfg(backend=backend, chaos=CLIENT_CHAOS, extra=extra,
+                       **tov)
+        if sec:
+            cfg.merge_overrides(sec)
+        return Simulator(cfg)
+
+    ref = build()
+    if sec:
+        assert ref._use_full, "defense did not force the FULL path"
+    ref.run()
+    blk = build(extra={"rounds_per_block": 4})
+    blk.run()
+    assert blk.block_fn is not None
+    _assert_histories_match(ref.history, blk.history)
+    _assert_trees_match(ref.server_state.params, blk.server_state.params)
+
+
+def _predict_masks(seed, round_idx, ids, dropout, straggler):
+    """Replicate the in-jit fault draw (parallel/round.py) on the host."""
+    rng = jax.random.fold_in(jax.random.key(seed), round_idx)
+    frng = jax.random.fold_in(rng, 0xFA17)
+
+    def mask(rate, salt):
+        r = jax.random.fold_in(frng, salt)
+        return np.asarray(jax.vmap(lambda i: jax.random.bernoulli(
+            jax.random.fold_in(r, i), rate))(jnp.asarray(ids)))
+
+    dropped = mask(dropout, 1)
+    straggled = mask(straggler, 2) & ~dropped
+    return dropped, straggled
+
+
+def test_dropout_reweights_aggregate_over_survivors():
+    """The masked round equals a fault-free round whose weights were zeroed
+    by hand at exactly the faulted slots: the aggregate really renormalizes
+    over the survivors, in-jit, with no other change to the math."""
+    chaos_sim = Simulator(_sim_cfg(chaos=CLIENT_CHAOS))
+    ref_sim = Simulator(_sim_cfg())
+    r = 4
+    ids, weights = chaos_sim._pad_ids(chaos_sim.sample_clients(r))
+    dropped, straggled = _predict_masks(
+        0, r, ids, CLIENT_CHAOS["client_dropout"],
+        CLIENT_CHAOS["client_straggler"])
+    assert (dropped | straggled).any(), "seed draws no faults this round"
+    assert (~(dropped | straggled)).any(), "seed faults every client"
+    rng = jax.random.fold_in(jax.random.key(0), r)
+    out_chaos = chaos_sim.round_fn(
+        chaos_sim.server_state, chaos_sim.client_states, chaos_sim.data,
+        jnp.asarray(ids), jnp.asarray(weights), rng, chaos_sim.hook_state)
+    manual = weights * (~(dropped | straggled)).astype(np.float32)
+    out_ref = ref_sim.round_fn(
+        ref_sim.server_state, ref_sim.client_states, ref_sim.data,
+        jnp.asarray(ids), jnp.asarray(manual), rng, ref_sim.hook_state)
+    _assert_trees_match(out_chaos.server_state.params,
+                        out_ref.server_state.params, rtol=0, atol=0)
+    m_chaos = jax.device_get(out_chaos.metrics)
+    m_ref = jax.device_get(out_ref.metrics)
+    faults = m_chaos.pop("faults")
+    np.testing.assert_array_equal(faults["dropped"],
+                                  dropped.astype(np.float32))
+    np.testing.assert_array_equal(faults["straggled"],
+                                  straggled.astype(np.float32))
+    assert float(m_chaos["train_loss"]) == float(m_ref["train_loss"])
+
+
+def test_dropout_preserves_faulted_client_state():
+    """A faulted SCAFFOLD client's control variate keeps its pre-round value
+    — the lost report never mutates persistent client state."""
+    sim = Simulator(_sim_cfg(federated_optimizer="SCAFFOLD",
+                             chaos={"seed": 5, "client_dropout": 0.5}))
+    r = 2
+    ids, weights = sim._pad_ids(sim.sample_clients(r))
+    dropped, _ = _predict_masks(0, r, ids, 0.5, 0.0)
+    assert dropped.any() and (~dropped).any()
+    before = jax.device_get(
+        jax.tree.map(lambda a: np.asarray(a)[ids], sim.client_states))
+    out = sim.round_fn(sim.server_state, sim.client_states, sim.data,
+                       jnp.asarray(ids), jnp.asarray(weights),
+                       jax.random.fold_in(jax.random.key(0), r),
+                       sim.hook_state)
+    after = jax.device_get(
+        jax.tree.map(lambda a: np.asarray(a)[ids], out.client_states))
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(b[dropped], a[dropped])
+        assert not np.array_equal(b[~dropped], a[~dropped]), \
+            "survivors' states should have updated"
+
+
+def test_injected_faults_raise_health_flags_and_counters():
+    """Injected dropouts/stragglers are visibly caught by the PR-3 health
+    plane: fed.chaos.* counters, injected_* flag reasons through the
+    recorder, and participation that excludes the faulted clients."""
+    from fedml_tpu.utils.events import recorder
+
+    n0 = len(recorder.metrics)
+    sim = Simulator(_sim_cfg(chaos=CLIENT_CHAOS, comm_round=6))
+    sim.run()
+    c = mx.snapshot()["counters"]
+    nd = c.get("fed.chaos.client_dropouts", 0)
+    ns = c.get("fed.chaos.client_stragglers", 0)
+    assert nd > 0 and ns > 0
+    assert c.get("fed.health.flags_total", 0) >= nd + ns
+    # participation excludes faulted appearances: 6 rounds x 5 sampled
+    part = sum(v for k, v in c.items() if k.startswith("fed.participation."))
+    assert part == 6 * 5 - nd - ns
+    reasons = set()
+    for row in list(recorder.metrics)[n0:]:
+        for f in row.get("health", {}).get("flags", []):
+            reasons.update(f["reasons"])
+    assert {"injected_dropout", "injected_straggler"} <= reasons
+
+
+def test_async_simulator_injects_client_faults():
+    from fedml_tpu.simulation.async_simulator import AsyncSimulator
+
+    cfg = _sim_cfg(comm_round=6, client_num_per_round=4,
+                   chaos={"seed": 1, "client_dropout": 0.3,
+                          "client_straggler": 0.3})
+    sim = AsyncSimulator(cfg)
+    hist = sim.run()
+    assert hist, "async run produced no history"
+    c = mx.snapshot()["counters"]
+    assert c.get("fed.chaos.client_dropouts", 0) > 0
+    assert c.get("fed.chaos.client_stragglers", 0) > 0
+
+
+# ----------------------------------------------------------- satellites
+def test_grpc_send_deadline_on_black_holed_peer():
+    """A peer that accepts TCP but never speaks HTTP/2 used to hang the
+    sender forever; the per-RPC deadline turns that into a bounded error."""
+    grpc = pytest.importorskip("grpc")
+    import socket
+
+    from fedml_tpu.comm.grpc_transport import GrpcTransport
+
+    sink = socket.socket()
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)              # accepts connections, answers nothing
+    addr = f"127.0.0.1:{sink.getsockname()[1]}"
+    t = GrpcTransport(0, {1: addr}, port=0, rpc_timeout_s=0.5,
+                      send_retries=0)
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(grpc.RpcError):
+            t.send_message(Message("x", 0, 1).add("w", np.ones(4)))
+        assert time.perf_counter() - t0 < 10.0
+    finally:
+        t.shutdown(grace=0)
+        sink.close()
+
+
+def test_unknown_message_type_keeps_receive_loop_alive():
+    run = "unh"
+    a = FedCommManager(LoopbackTransport(0, run), 0)
+    b = FedCommManager(LoopbackTransport(1, run), 1)
+    got = []
+    b.register_message_receive_handler("known", lambda m: got.append(m))
+    b.run(background=True)
+    a.send_message(Message("mystery", 0, 1))      # used to kill the loop
+    a.send_message(Message("known", 0, 1))
+    for _ in range(100):
+        if got:
+            break
+        time.sleep(0.02)
+    b.stop()
+    release_router(run)
+    assert got, "receive loop died on the unknown message type"
+    assert mx.snapshot()["counters"].get("comm.msgs_unhandled") == 1
+
+
+def test_faulty_handler_does_not_kill_transport_pump():
+    run = "hfail"
+    a = FedCommManager(LoopbackTransport(0, run), 0)
+    b = FedCommManager(LoopbackTransport(1, run), 1)
+    got = []
+
+    def handler(m):
+        if m.get("boom"):
+            raise RuntimeError("handler bug")
+        got.append(m.get("i"))
+
+    b.register_message_receive_handler("h", handler)
+    b.run(background=True)
+    a.send_message(Message("h", 0, 1).add("boom", True))
+    a.send_message(Message("h", 0, 1).add("i", 1))
+    for _ in range(100):
+        if got:
+            break
+        time.sleep(0.02)
+    b.stop()
+    release_router(run)
+    assert got == [1], "pump died with the faulty handler"
+    assert mx.snapshot()["counters"].get("comm.handler_errors", 0) >= 1
+
+
+def test_diagnosis_includes_chaos_smoke(capsys):
+    import json
+
+    from fedml_tpu.__main__ import main
+
+    rc = main(["diagnosis"])
+    out = json.loads(capsys.readouterr().out)
+    assert "chaos_smoke" in out["checks"]
+    assert out["checks"]["chaos_smoke"]["ok"], out["checks"]["chaos_smoke"]
+    assert out["checks"]["chaos_smoke"]["faults_injected"] > 0
+    assert rc == 0
