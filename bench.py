@@ -451,6 +451,97 @@ def bench_reliable_comm() -> dict:
     }
 
 
+def bench_serving_cb(quick: bool = False) -> dict:
+    """Continuous-batching serving row (ISSUE 5): a concurrency-8
+    synthetic decode workload — 8 prompts of assorted lengths, 24 new
+    tokens each — through (a) the per-request path (each request is its
+    own prefill+scan program; concurrent requests serialize on the
+    device) and (b) the slot engine (serving/engine.py: one persistent
+    donated KV cache, all active requests advance one token per jitted
+    step). Reports aggregate tokens/sec both ways, the speedup, and the
+    engine's TTFT p50 measured over this run (histogram count-delta, so
+    the figure is this workload's, not the process's). Acceptance bar:
+    >= 2x on CPU; on TPU the expectation is slot-count-bounded scaling
+    (batch-S decode steps cost ~one step's HBM weight sweep until the
+    MXU saturates, so aggregate tokens/sec approaches S x the
+    single-stream rate for small S)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.llm.transformer import TransformerLM
+    from fedml_tpu.serving.predictor import GreedyLMPredictor
+    from fedml_tpu.utils import metrics as _mx
+    from fedml_tpu.utils.metrics import percentile_from_counts
+
+    conc, new = 8, 24
+    if quick:
+        dims = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=4,
+                    d_ff=256)
+    else:
+        dims = dict(vocab_size=512, d_model=512, n_layers=4, n_heads=8,
+                    d_ff=1536)
+    model = TransformerLM(**dims, scan_layers=True)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, dims["vocab_size"], n).tolist()
+               for n in (10, 14, 12, 9, 16, 11, 13, 15)]
+
+    def run_concurrent(pred):
+        errs: list = []
+
+        def hit(i):
+            try:
+                pred.predict({"tokens": prompts[i], "max_new_tokens": new})
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return conc * new / (time.perf_counter() - t0)
+
+    per = GreedyLMPredictor(model, params, max_len=128, kv_cache=True)
+    per.predict({"tokens": prompts[0], "max_new_tokens": new})   # compile
+    per_tps = max(run_concurrent(per) for _ in range(2))
+
+    eng = GreedyLMPredictor(model, params, max_len=128, kv_cache=True,
+                            decode_slots=conc)
+    try:
+        eng.predict({"tokens": prompts[0], "max_new_tokens": new})  # compile
+        h = _mx.registry.histogram("serving.ttft")
+        before = h._merged()[0]
+        eng_tps = max(run_concurrent(eng) for _ in range(2))
+        after = h._merged()[0]
+        delta = [a - b for a, b in zip(after, before)]
+        # observed_max deliberately omitted: the histogram's max spans the
+        # process lifetime (it would leak the warm-up compile's TTFT into
+        # this run's figure); an overflow-bucket p50 reports the last edge
+        ttft_p50 = percentile_from_counts(h.edges, delta, 0.5)
+    finally:
+        eng.stop()
+    return {
+        "serving_cb_tokens_per_sec": round(eng_tps, 1),
+        "serving_cb_per_request_tokens_per_sec": round(per_tps, 1),
+        "serving_cb_speedup_vs_per_request": round(eng_tps / per_tps, 2),
+        "serving_cb_ttft_p50_ms": (round(ttft_p50 * 1e3, 1)
+                                   if ttft_p50 is not None else None),
+        "serving_cb_config": (f"conc{conc} new{new} slots{conc} "
+                              f"d{dims['d_model']} L{dims['n_layers']} "
+                              f"vocab{dims['vocab_size']} maxlen128"
+                              + (" quick" if quick else "")),
+    }
+
+
 def bench_workload4_hierarchical() -> dict:
     """BASELINE workload 4: hierarchical cross-silo — per-silo inner
     allreduce (intra axis) + outer aggregate (silos axis), one XLA program
@@ -1012,6 +1103,9 @@ _HEADLINE_KEYS = (
     "w1_health_overhead_pct",
     # chaos plane + reliable delivery (ISSUE 4): protocol-overhead row
     "w1_reliable_comm_overhead_pct",
+    # continuous-batching serving (ISSUE 5): concurrency-8 decode row
+    "serving_cb_speedup_vs_per_request", "serving_cb_tokens_per_sec",
+    "serving_cb_ttft_p50_ms",
     "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",
@@ -1067,6 +1161,8 @@ def main():
                {"w1_error": "bench_workload1 failed twice"})
     acc.update(_retrying(bench_reliable_comm, default=None) or
                {"w1_reliable_comm_error": "bench_reliable_comm failed twice"})
+    acc.update(_retrying(bench_serving_cb, quick, default=None) or
+               {"serving_cb_error": "bench_serving_cb failed twice"})
     if not quick:
         acc.update(_retrying(bench_workload4_hierarchical, default=None) or
                    {"w4_error": "bench_workload4 failed twice"})

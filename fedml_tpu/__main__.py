@@ -406,9 +406,9 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
         lines.append(seg)
 
     # -------------------------------------------------------------- serving
-    if "serving_requests_total" in c:
-        seg = (f"serving: requests {int(c['serving_requests_total'])}  "
-               f"errors {int(c.get('serving_errors_total', 0))}  "
+    if "serving_requests_total" in c or "serving_tokens_total" in c:
+        seg = (f"serving: requests {int(c.get('serving_requests_total', 0))}"
+               f"  errors {int(c.get('serving_errors_total', 0))}  "
                f"queue {int(g.get('serving_queue_depth', 0))}")
         sh = h.get("serving_request_s")
         if sh and sh["count"]:
@@ -416,6 +416,22 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             if p50 is not None:
                 seg += f"  p50<={p50 * 1e3:.2f}ms"
         lines.append(seg)
+        # continuous-batching engine plane (serving/engine.py)
+        if "serving_tokens_total" in c:
+            seg = (f"engine: tokens {int(c['serving_tokens_total'])}  "
+                   f"slots {int(g.get('serving_slots_active', 0))}  "
+                   f"queue {int(g.get('serving_engine_queue', 0))}")
+            tr = rate("serving_tokens_total")
+            if tr is not None:
+                seg += f"  tok/s {tr:.1f}"
+            for label, key in (("ttft", "serving_ttft"),
+                               ("tbt", "serving_tbt")):
+                hh = h.get(key)
+                if hh and hh["count"]:
+                    p50 = histogram_percentile(hh["buckets"], 0.5)
+                    if p50 is not None:
+                        seg += f"  {label}_p50<={p50 * 1e3:.2f}ms"
+            lines.append(seg)
 
     # ------------------------------------------------------------- retraces
     retr = {k: int(v) for k, v in c.items() if k.startswith("xla_retraces_")}
@@ -646,6 +662,60 @@ def cmd_diagnosis(args) -> int:
             b.stop()
             release_router(run)
 
+    def serving_engine_smoke():
+        # the continuous-batching plane end-to-end (ISSUE 5): a tiny LM on
+        # the slot engine, 8 concurrent requests — every request must get
+        # exactly one response, more than one slot must have been active
+        # at once, and the compiled-program set must stay bounded (one
+        # step program + one admit program per prompt bucket).
+        import threading as _th
+        import time as _t
+
+        import jax as _jax
+        import jax.numpy as _jnp
+        import numpy as _np
+
+        from .llm.transformer import TransformerLM
+        from .serving.engine import DecodeEngine
+        from .utils import metrics as mx
+
+        model = TransformerLM(vocab_size=64, d_model=32, n_layers=1,
+                              n_heads=2, d_ff=64, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        rs = _np.random.RandomState(0)
+        prompts = [rs.randint(1, 64, n).tolist()
+                   for n in (4, 6, 5, 7, 4, 6, 5, 7)]
+        eng = DecodeEngine(model, params, n_slots=4, max_len=32).start()
+        max_active = [0]
+        stop = _th.Event()
+
+        def poll():
+            g = mx.registry.gauge("serving.slots_active")
+            while not stop.is_set():
+                max_active[0] = max(max_active[0], int(g.value()))
+                _t.sleep(0.002)
+
+        _th.Thread(target=poll, daemon=True).start()
+        try:
+            tickets = [eng.submit(p, 6) for p in prompts]
+            outs = [t.result(timeout=60) for t in tickets]
+        finally:
+            stop.set()
+            counts = eng.program_counts()
+            eng.stop()
+        if len(outs) != 8 or any(len(o) != 6 for o in outs):
+            raise ValueError(f"responses malformed: {[len(o) for o in outs]}")
+        if max_active[0] <= 1:
+            raise ValueError("slots never decoded concurrently "
+                             f"(max slots_active {max_active[0]})")
+        if counts["step"] not in (None, 1):
+            raise ValueError(f"step program retraced: {counts}")
+        if counts["admit"] is not None and counts["admit"] > 2:
+            raise ValueError(f"admit programs unbounded: {counts}")
+        return {"requests": 8, "max_slots_active": max_active[0],
+                "programs": counts}
+
     check("jax", jax_devices)
     check("wire_codec", wire)
     check("loopback_transport", loopback)
@@ -653,9 +723,10 @@ def cmd_diagnosis(args) -> int:
     check("native_lib", native)
     check("metrics_endpoint", metrics_endpoint)
     check("chaos_smoke", chaos_smoke)
+    check("serving_engine_smoke", serving_engine_smoke)
     required_ok = all(checks[k]["ok"] for k in
                       ("jax", "wire_codec", "loopback_transport",
-                       "chaos_smoke"))
+                       "chaos_smoke", "serving_engine_smoke"))
     print(json.dumps({"ok": required_ok, "checks": checks}, indent=2))
     return 0 if required_ok else 1
 

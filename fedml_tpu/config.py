@@ -152,6 +152,20 @@ class DPArgs:
 
 
 @dataclass
+class ServeArgs:
+    """Model-serving knobs (serving/). All engine knobs ride `extra` so
+    reference YAMLs (which have no serving section) load unchanged:
+      decode_slots      — >0 starts the continuous-batching DecodeEngine
+                          (serving/engine.py) with that many slots
+      engine_max_len    — per-slot KV capacity (prompt + max_new <= this)
+      engine_eos_id     — token id that retires a slot early (omit: none)
+      engine_fetch_chunk — device frames kept in flight before the host
+                          fetches (dispatch-ahead depth)
+      sampler_cache_size — LRU cap on per-top_k compiled samplers"""
+    extra: dict = field(default_factory=dict)
+
+
+@dataclass
 class Config:
     common_args: CommonArgs = field(default_factory=CommonArgs)
     data_args: DataArgs = field(default_factory=DataArgs)
@@ -163,6 +177,7 @@ class Config:
     tracking_args: TrackingArgs = field(default_factory=TrackingArgs)
     security_args: SecurityArgs = field(default_factory=SecurityArgs)
     dp_args: DPArgs = field(default_factory=DPArgs)
+    serve_args: ServeArgs = field(default_factory=ServeArgs)
     # role assignment for cross-silo runs (reference: arguments.py --rank/--role)
     rank: int = 0
     role: str = "server"
@@ -184,11 +199,23 @@ class Config:
         "tracking_args": TrackingArgs,
         "security_args": SecurityArgs,
         "dp_args": DPArgs,
+        "serve_args": ServeArgs,
     }
 
     @classmethod
     def from_dict(cls, d: dict) -> "Config":
         cfg = cls()
+        # "serve" is accepted as an alias for "serve_args" (the serving
+        # docs/specs use the short name; every other section is *_args).
+        # Both present is ambiguous — refusing beats silently dropping one
+        # (a merged-YAML pipeline losing decode_slots would bring the
+        # replica up in per-request mode with no signal)
+        if "serve" in d and isinstance(d["serve"], dict):
+            if "serve_args" in d:
+                raise ValueError(
+                    "config has both 'serve' and 'serve_args' sections — "
+                    "'serve' is an alias for 'serve_args'; keep one")
+            d = {**d, "serve_args": d["serve"]}
         for section, typ in cls.SECTION_TYPES.items():
             if section in d and isinstance(d[section], dict):
                 _apply(getattr(cfg, section), d[section])
@@ -296,6 +323,39 @@ class Config:
                 raise ValueError(
                     "common_args.extra.metrics_port must be an integer in "
                     f"[0, 65535] (0 = ephemeral); got {mp!r}")
+        # continuous-batching serving knobs (serving/engine.py), validated
+        # at load so a typo'd YAML fails before a replica silently comes up
+        # in per-request mode (decode_slots=0 IS the per-request path).
+        # serve_args is fully owned by this framework (no reference-YAML
+        # grab-bag to stay compatible with), so UNKNOWN keys are rejected
+        # too — a misspelled decode_slots must not pass silently.
+        _serve_knobs = {"decode_slots", "engine_max_len",
+                        "engine_fetch_chunk", "engine_eos_id",
+                        "sampler_cache_size", "kv_cache"}
+        unknown = set(self.serve_args.extra) - _serve_knobs
+        if unknown:
+            raise ValueError(
+                f"unknown serve_args knob(s) {sorted(unknown)}; valid: "
+                f"{sorted(_serve_knobs)}")
+        kvc = self.serve_args.extra.get("kv_cache")
+        if kvc is not None and not isinstance(kvc, bool):
+            raise ValueError(
+                f"serve_args.kv_cache must be a boolean; got {kvc!r}")
+        for knob, lo in (("decode_slots", 0), ("engine_max_len", 1),
+                         ("engine_fetch_chunk", 1), ("engine_eos_id", 0),
+                         ("sampler_cache_size", 1)):
+            val = self.serve_args.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = (not isinstance(val, bool)
+                      and int(val) == float(val) and int(val) >= lo)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"serve_args.{knob} must be an integer >= {lo}; "
+                    f"got {val!r}")
         # chaos plane + reliable delivery knobs (ISSUE 4): both specs are
         # parsed by their owning modules so validation never drifts from the
         # consumer; lazy imports keep config load jax-free and cycle-free.

@@ -84,6 +84,13 @@ def stack_adapter_blocks(adapters: Optional[Pytree],
     return out
 
 
+def _batched_keys(key) -> bool:
+    """True iff `key` is a [B] TYPED key array (per-row rng streams).
+    Shape truthiness alone would misroute a legacy uint32[2] PRNGKey —
+    ndim 1 but not a key array — into the vmap path and crash."""
+    return key.ndim == 1 and jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+
+
 def _rope_rows(x, pos_rows, base: float = 10000.0):
     """transformer.rope generalized to PER-ROW positions: x [B, T, H, D],
     pos_rows [B, T] — identical math (angles = pos·freqs, rotate halves),
@@ -237,7 +244,10 @@ def make_generate(n_heads: int, alpha: float = 16.0,
     softmax(logits / temperature) with an optional static top_k cutoff
     (the HF generate() sampling knobs the reference's serving inherits);
     temperature is TRACED, so one compiled program covers every
-    temperature, while top_k and sample are compile-time."""
+    temperature, while top_k and sample are compile-time. `rng` may be a
+    single key (one stream shared by the batch) or a [B] key array —
+    per-row streams, under which batched row i samples the exact tokens
+    decoding prompt i alone with rng[i] would."""
     prefill, step = make_kv_decode(n_heads, alpha=alpha, dtype=dtype,
                                    eps=eps, prefill_attn_fn=prefill_attn_fn)
 
@@ -248,6 +258,15 @@ def make_generate(n_heads: int, alpha: float = 16.0,
         if top_k:
             kth = jax.lax.top_k(l, top_k)[0][..., -1:]
             l = jnp.where(l < kth, -jnp.inf, l)
+        if _batched_keys(key):
+            # PER-ROW keys ([B] key array): each batched row draws with its
+            # own stream, so row i reproduces exactly what decoding that
+            # prompt ALONE with keys[i] would draw (a shared key would give
+            # the batch one [B, V] gumbel field whose row i differs from
+            # the batch-1 field — batched/solo sampling parity needs this)
+            return jax.vmap(
+                lambda k, row: jax.random.categorical(k, row, -1))(
+                    key, l).astype(jnp.int32)
         return jax.random.categorical(key, l, -1).astype(jnp.int32)
 
     def generate(params, adapters, tokens, max_len: int, n_steps: int,
@@ -262,9 +281,19 @@ def make_generate(n_heads: int, alpha: float = 16.0,
         n_steps tokens in lockstep through one program)."""
         if rng is None:
             rng = jax.random.key(0)
+
+        def fold(key, i):
+            # rng may be one key (shared stream, the serving default —
+            # typed or legacy uint32[2]) or a [B] typed key array
+            # (per-row streams — see pick())
+            if _batched_keys(key):
+                return jax.vmap(jax.random.fold_in,
+                                in_axes=(0, None))(key, i)
+            return jax.random.fold_in(key, i)
+
         cache, logits = prefill(params, adapters, tokens, max_len,
                                 length=length)
-        first = pick(logits, jax.random.fold_in(rng, 0), temperature)
+        first = pick(logits, fold(rng, 0), temperature)
         b = tokens.shape[0]
         pos0 = jnp.broadcast_to(
             jnp.asarray(tokens.shape[1] if length is None else length,
@@ -273,7 +302,7 @@ def make_generate(n_heads: int, alpha: float = 16.0,
         def one(carry, i):
             cache, tok = carry
             cache, logits = step(params, adapters, cache, pos0 + i, tok)
-            nxt = pick(logits, jax.random.fold_in(rng, i + 1), temperature)
+            nxt = pick(logits, fold(rng, i + 1), temperature)
             return (cache, nxt), nxt
 
         # n_steps - 1 decode steps: token 1 comes from prefill, and the

@@ -5,8 +5,10 @@ FedMLInferenceRunner FastAPI app, fedml_server.py reusing cross-silo init
 for federated serving.)
 
 Layer map position: L3 runtime (SURVEY.md §1). The compute path is a jitted
-bucketed forward (serving/predictor.py); the HTTP surface mirrors the
-reference's /predict + /ready contract (serving/inference_runner.py).
+bucketed forward (serving/predictor.py); LLM requests can opt into the
+continuous-batching slot engine (serving/engine.py — one persistent donated
+KV cache, concurrent requests share device steps); the HTTP surface mirrors
+the reference's /predict + /ready contract (serving/inference_runner.py).
 `serve_simulator` is the federated-serving bridge: serve the global model a
 Simulator trained (or a checkpoint directory it saved).
 """
@@ -14,16 +16,35 @@ from __future__ import annotations
 
 from typing import Callable
 
+from .engine import DecodeEngine, Ticket
 from .export import export_model, load_export, predictor_from_export
 from .inference_runner import DEFAULT_PORT, FedMLInferenceRunner
 from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
 
 __all__ = [
     "Predictor", "JaxPredictor", "GreedyLMPredictor",
+    "DecodeEngine", "Ticket", "lm_predictor_from_config",
     "FedMLInferenceRunner", "DEFAULT_PORT", "serve_simulator",
     "predictor_from_checkpoint", "predictor_from_artifact",
     "export_model", "load_export", "predictor_from_export",
 ]
+
+
+def lm_predictor_from_config(cfg, model, params, adapters=None,
+                             detokenize=None) -> "GreedyLMPredictor":
+    """Build the LM serving predictor from a Config's `serve_args` section
+    (YAML key `serve_args`, alias `serve` — validated at load,
+    config.py): `decode_slots` > 0 starts the continuous-batching engine,
+    `engine_max_len`/`engine_eos_id`/`engine_fetch_chunk`/
+    `sampler_cache_size`/`kv_cache` tune it. This is the config-side
+    consumer of cfg.serve_args; the deploy path (scheduler.start_replica)
+    feeds the serve-spec dict through the SAME knob mapping
+    (predictor.lm_predictor_from_serve_knobs)."""
+    from .predictor import lm_predictor_from_serve_knobs
+
+    return lm_predictor_from_serve_knobs(
+        cfg.serve_args.extra, model, params, adapters=adapters,
+        detokenize=detokenize)
 
 
 def predictor_from_artifact(store, round_idx: int,

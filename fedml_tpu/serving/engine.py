@@ -1,0 +1,484 @@
+"""Continuous-batching decode engine: slot-based LLM serving on one
+persistent, donated KV cache.
+
+Why: the per-request serving path (serving/predictor.py GreedyLMPredictor)
+runs each request's prefill+decode as its own device program end-to-end, so
+N concurrent users get N serialized programs — aggregate tokens/sec is flat
+in concurrency while the chip idles between requests. The decode plumbing
+already supports per-row write positions (llm/decode.py `step(params,
+adapters, cache, pos, token)` with `pos: [B]`), which is exactly the
+primitive continuous batching needs; this module turns it into an engine
+(the vLLM-style iteration-level scheduler, minus paging: slots are
+fixed-stride rows of one cache).
+
+Shape of the thing:
+
+- The engine owns S decode *slots* backed by ONE persistent KV cache
+  (`{"k","v"}: [L, S, max_len, H, Dh]`) that stays device-resident across
+  requests — no per-request cache allocation, and every jitted call
+  DONATES the carry so XLA updates it in place.
+- Admission: a free slot + a waiting request -> one bucketed prefill
+  (prompts right-padded to a power-of-two bucket, real length traced; same
+  bucketing contract as the per-request path) whose K/V rows are written
+  into the persistent cache at the slot index via `dynamic_update_slice`
+  over the slot axis. The prefill's last-position logits yield the
+  request's FIRST token inside the same program.
+- Every engine iteration advances ALL slots one token through a single
+  jitted step with per-slot `pos`, per-slot traced temperature + rng seed,
+  and an active-mask so idle slots are inert (their K/V writes land on
+  frozen positions and are fully overwritten by the next admission's
+  prefill row).
+- Retirement is decided ON DEVICE: a slot deactivates when it hits its
+  per-request token budget (`limit`) or emits `eos_id`; the host merely
+  observes the mask in fetched frames, completes the ticket, and returns
+  the slot to the free list.
+- The host loop dispatches ahead: step/admit outputs queue as device
+  arrays and are fetched in small chunks (`fetch_chunk`), so admission and
+  retirement bookkeeping overlap device execution — no per-step
+  `device_get` barrier.
+
+Compiled-program set stays BOUNDED: one step program (all S slots, every
+temperature/seed traced) + one admit program per prompt bucket
+(log2(max_len) of them at most). `program_counts()` exposes the live jit
+cache sizes; tests pin them.
+
+Capacity contract per slot: `prompt_len + max_new_tokens <= max_len`
+(no step bucketing — the engine emits exactly the tokens asked for, so
+unlike the per-request path max_new_tokens is not rounded up).
+
+Equivalence contract: for identical prompts, greedy engine output is
+token-identical to the per-request path — the slot axis is data-parallel
+through the decode math (pinned in tests/test_serving_engine.py).
+
+Telemetry rides the existing planes: `serving.ttft` / `serving.tbt`
+histograms, `serving.slots_active` gauge, `serving.tokens_total` counter,
+`serving.engine.*` counters, and `serving.engine.admit` / `.fetch` spans
+on the Chrome trace — all visible in `/metrics` and `python -m fedml_tpu
+top`.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import metrics as _mx
+from ..utils.events import recorder
+from .predictor import InvalidRequest, _bucket
+
+log = logging.getLogger(__name__)
+Pytree = Any
+
+
+class Ticket:
+    """Per-request handle: the HTTP handler blocks on `result()` while the
+    engine thread decodes — requests no longer serialize through one
+    global jit call; concurrency is bounded by slots, not threads."""
+
+    __slots__ = ("_done", "_tokens", "_error", "t_submit", "t_first")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._tokens: list[int] = []
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        """Block until the request retires; returns the generated tokens
+        (the eos token, when one ended generation, is included)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("decode engine ticket not done "
+                               f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return list(self._tokens)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class _Request:
+    __slots__ = ("tokens", "max_new", "temperature", "seed", "ticket")
+
+    def __init__(self, tokens, max_new, temperature, seed):
+        self.tokens = tokens
+        self.max_new = max_new
+        self.temperature = temperature
+        self.seed = seed
+        self.ticket = Ticket()
+
+
+class _SlotState:
+    """Host-side view of an occupied slot (the device mask is the source
+    of truth for retirement; this mirrors it frame-by-frame)."""
+
+    __slots__ = ("req", "out", "t_first")
+
+    def __init__(self, req: _Request):
+        self.req = req
+        self.out: list[int] = []
+        self.t_first: Optional[float] = None
+
+
+class DecodeEngine:
+    """S-slot continuous-batching decoder over llm/decode.py's functional
+    prefill/step.
+
+    `model` is a llm.TransformerLM (its n_layers/n_heads/d_model size the
+    cache); `params`/`adapters` may be unrolled or scan-layout (stacked
+    here, pass-through if already stacked) and float or int8 {q,s}.
+    `eos_id=None` disables eos retirement (requests always run their full
+    max_new_tokens — the mode the greedy-equivalence contract is pinned
+    in). Sampling: per-slot traced temperature; temperature <= 0 means
+    greedy; full-vocab categorical (top_k requests stay on the
+    per-request path, which compiles a static-k cutoff)."""
+
+    def __init__(self, model, params: Pytree,
+                 adapters: Optional[Pytree] = None, *,
+                 n_slots: int = 4, max_len: int = 256,
+                 eos_id: Optional[int] = None,
+                 dtype=None, fetch_chunk: int = 2):
+        from ..llm.decode import (
+            make_kv_decode, stack_adapter_blocks, stack_blocks,
+        )
+
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1; got {n_slots}")
+        self.model = model
+        self.max_len = int(max_len)
+        self.n_slots = int(n_slots)
+        self.fetch_chunk = max(1, int(fetch_chunk))
+        # -1 never matches a token id, so eos retirement is inert
+        self._eos = -1 if eos_id is None else int(eos_id)
+        self.adapters = stack_adapter_blocks(adapters, model.n_layers)
+        self.params = stack_blocks(params, model.n_layers)
+        if dtype is not None:
+            kv_dtype = jnp.dtype(dtype)
+        else:
+            floats = [l for l in jax.tree.leaves(self.params)
+                      if jnp.issubdtype(l.dtype, jnp.floating)]
+            kv_dtype = floats[0].dtype if floats else jnp.float32
+        self._kv_dtype = kv_dtype
+        prefill, step = make_kv_decode(model.n_heads, dtype=kv_dtype)
+        S, eos, max_len_ = self.n_slots, self._eos, self.max_len
+
+        def pick(logits, temp, key):
+            """Greedy/sampled select with temperature TRACED (one program
+            covers both): softmax sampling computes alongside and a where
+            picks — the greedy lane is bit-identical to the per-request
+            path's argmax."""
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            l = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)[
+                ..., None]
+            if logits.ndim == 1:
+                sampled = jax.random.categorical(key, l, -1)
+            else:
+                sampled = jax.vmap(
+                    lambda k, row: jax.random.categorical(k, row, -1))(
+                        key, l)
+            return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
+
+        def _admit(params, adapters, carry, tokens, length, slot, temp,
+                   seed, limit):
+            """Prefill one request into slot `slot` of the donated carry:
+            K/V rows land at the slot index of the persistent cache, the
+            prompt's last-position logits yield the first token, and the
+            slot's pos/tok/active/temp/seed/limit rows are set."""
+            row, logits = prefill(params, adapters, tokens, max_len_,
+                                  length=length)
+            key = jax.random.fold_in(jax.random.key(seed), length)
+            first = pick(logits[0], temp, key)
+            start = (0, slot, 0, 0, 0)
+            cache = {
+                "k": jax.lax.dynamic_update_slice(
+                    carry["cache"]["k"], row["k"], start),
+                "v": jax.lax.dynamic_update_slice(
+                    carry["cache"]["v"], row["v"], start),
+            }
+            # active iff the first token did not end it and there is
+            # budget left (limit = length + max_new - 1: the position
+            # after which no further step token is owed)
+            active = (first != eos) & (length < limit)
+            return {
+                "cache": cache,
+                "pos": carry["pos"].at[slot].set(length),
+                "tok": carry["tok"].at[slot].set(first),
+                "active": carry["active"].at[slot].set(active),
+                "temp": carry["temp"].at[slot].set(temp),
+                "seed": carry["seed"].at[slot].set(seed),
+                "limit": carry["limit"].at[slot].set(limit),
+            }, first
+
+        def _step_all(params, adapters, carry):
+            """Advance every slot one token through ONE program. Inactive
+            slots are inert: pos frozen, tok unchanged, their (garbage)
+            K/V write lands on a frozen position that the next admission's
+            full prefill row overwrites."""
+            cache, logits = step(params, adapters, carry["cache"],
+                                 carry["pos"], carry["tok"])
+            active, temp = carry["active"], carry["temp"]
+            keys = jax.vmap(
+                lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1))(
+                    carry["seed"], carry["pos"])
+            nxt = pick(logits, temp, keys)
+            pos2 = jnp.where(active, carry["pos"] + 1, carry["pos"])
+            act2 = active & (pos2 < carry["limit"]) & (nxt != eos)
+            out = {
+                "cache": cache,
+                "pos": pos2,
+                "tok": jnp.where(active, nxt, carry["tok"]),
+                "active": act2,
+                "temp": temp,
+                "seed": carry["seed"],
+                "limit": carry["limit"],
+            }
+            # emitted token per slot + the entry mask saying which are real
+            return out, (nxt, active)
+
+        # the carry is DONATED: the cache never round-trips host<->device
+        # and XLA may update the slot rows in place
+        self._admit_jit = jax.jit(_admit, donate_argnums=(2,))
+        self._step_jit = jax.jit(_step_all, donate_argnums=(2,))
+
+        head = model.d_model // model.n_heads
+        z = (model.n_layers, S, self.max_len, model.n_heads, head)
+        self._carry = {
+            "cache": {"k": jnp.zeros(z, kv_dtype),
+                      "v": jnp.zeros(z, kv_dtype)},
+            "pos": jnp.zeros((S,), jnp.int32),
+            "tok": jnp.zeros((S,), jnp.int32),
+            "active": jnp.zeros((S,), bool),
+            "temp": jnp.zeros((S,), jnp.float32),
+            "seed": jnp.zeros((S,), jnp.uint32),
+            "limit": jnp.zeros((S,), jnp.int32),
+        }
+
+        self._cond = threading.Condition()
+        self._waiting: deque[_Request] = deque()
+        self._free: list[int] = list(range(S))
+        self._slots: list[Optional[_SlotState]] = [None] * S
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "DecodeEngine":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._fail_outstanding(RuntimeError("decode engine stopped"))
+
+    # ------------------------------------------------------------ admission
+    def submit(self, tokens, max_new_tokens: int,
+               temperature: float = 0.0,
+               seed: Optional[int] = None) -> Ticket:
+        """Queue one prompt; returns the Ticket its tokens stream to.
+        Capacity contract: prompt + max_new_tokens <= max_len (exact — the
+        engine never buckets the token budget)."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise InvalidRequest(
+                "tokens must contain at least one prompt token")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise InvalidRequest(
+                f"max_new_tokens must be >= 1; got {max_new}")
+        if len(tokens) + max_new > self.max_len:
+            raise InvalidRequest(
+                f"prompt {len(tokens)} + max_new_tokens {max_new} exceeds "
+                f"max_len {self.max_len} (engine slot capacity contract: "
+                "prompt + max_new_tokens <= max_len)")
+        if seed is None:
+            import random as _random
+
+            seed = _random.getrandbits(31)
+        # the per-slot seed rides as a device uint32 — mask client-supplied
+        # values into range instead of letting jnp.uint32 overflow on the
+        # engine thread (still deterministic per seed)
+        seed = int(seed) & 0xFFFFFFFF
+        req = _Request(tokens, max_new, float(temperature), seed)
+        with self._cond:
+            if self._stopping or (self._thread is not None
+                                  and not self._thread.is_alive()):
+                raise RuntimeError("decode engine is stopped")
+            if self._thread is None:
+                raise RuntimeError("decode engine not started "
+                                   "(call .start())")
+            self._waiting.append(req)
+            _mx.set_gauge("serving.engine.queue", len(self._waiting))
+            self._cond.notify_all()
+        _mx.inc("serving.engine.requests")
+        return req.ticket
+
+    # ------------------------------------------------------- introspection
+    def program_counts(self) -> dict:
+        """Live compiled-program counts: {"step": 1, "admit": <=
+        log2(max_len)} in steady state — the retrace guard tests pin."""
+        out = {}
+        for name, fn in (("step", self._step_jit),
+                         ("admit", self._admit_jit)):
+            try:
+                out[name] = fn._cache_size()
+            except Exception:  # jax without the introspection hook
+                out[name] = None
+        return out
+
+    # ------------------------------------------------------------ engine loop
+    def _loop(self) -> None:
+        # frames: ("admit", slot, first_token_dev) | ("step", toks, mask)
+        pending: deque[tuple] = deque()
+        try:
+            while True:
+                with self._cond:
+                    if self._stopping:
+                        break
+                    idle = (not self._waiting and not pending
+                            and all(s is None for s in self._slots))
+                    if idle:
+                        self._cond.wait(0.2)
+                        continue
+                self._admit_ready(pending)
+                if any(s is not None for s in self._slots):
+                    self._carry, (toks, mask) = self._step_jit(
+                        self.params, self.adapters, self._carry)
+                    pending.append(("step", toks, mask))
+                # drain: normally keep `fetch_chunk` frames in flight so
+                # host bookkeeping overlaps device steps; drain eagerly
+                # when requests are starved for a slot (a completion frees
+                # one) or nothing new was dispatched
+                with self._cond:
+                    starved = bool(self._waiting) and not self._free
+                eager = starved or all(s is None for s in self._slots)
+                while pending and (eager
+                                   or len(pending) >= self.fetch_chunk):
+                    self._drain(pending.popleft())
+        except BaseException as e:  # noqa: BLE001 — fail tickets, not silently
+            log.exception("decode engine loop died")
+            _mx.inc("serving.engine.errors")
+            # mark stopped FIRST so submit() refuses (and the predictor
+            # falls back to the per-request path) instead of queueing
+            # tickets nothing will ever complete
+            with self._cond:
+                self._stopping = True
+            self._fail_outstanding(
+                RuntimeError(f"decode engine failed: {type(e).__name__}: {e}"))
+
+    def _admit_ready(self, pending: deque) -> None:
+        while True:
+            with self._cond:
+                if not (self._free and self._waiting):
+                    return
+                req = self._waiting.popleft()
+                slot = self._free.pop()
+                # claim the slot in the SAME critical section as the pop:
+                # a stop() racing a long admit compile must find the
+                # request either in _waiting or in _slots — never in
+                # between (its ticket would hang its HTTP thread 600s)
+                self._slots[slot] = _SlotState(req)
+                _mx.set_gauge("serving.engine.queue", len(self._waiting))
+            with recorder.span("serving.engine.admit", slot=slot,
+                               prompt=len(req.tokens)):
+                # the SAME bucket fn as the per-request path, so both
+                # paths share one bounded prompt-bucket set
+                pb = min(_bucket(len(req.tokens), pow2_cap=self.max_len),
+                         self.max_len)
+                buf = np.zeros((1, pb), np.int32)
+                buf[0, :len(req.tokens)] = req.tokens
+                limit = len(req.tokens) + req.max_new - 1
+                self._carry, first = self._admit_jit(
+                    self.params, self.adapters, self._carry,
+                    jnp.asarray(buf), jnp.int32(len(req.tokens)),
+                    jnp.int32(slot), jnp.float32(req.temperature),
+                    jnp.uint32(req.seed), jnp.int32(limit))
+            pending.append(("admit", slot, first))
+            _mx.inc("serving.engine.admissions")
+
+    # -------------------------------------------------------------- draining
+    def _drain(self, frame: tuple) -> None:
+        """Materialize one queued frame and route its tokens. This is the
+        only host<->device sync point; the span measures the actual wait."""
+        if frame[0] == "admit":
+            _kind, slot, first = frame
+            with recorder.span("serving.engine.fetch", kind="admit"):
+                tok = int(np.asarray(first))
+            self._deliver(slot, tok, first=True)
+            _mx.set_gauge("serving.slots_active",
+                          sum(s is not None for s in self._slots))
+            return
+        _kind, toks_dev, mask_dev = frame
+        with recorder.span("serving.engine.fetch", kind="step"):
+            toks = np.asarray(toks_dev)
+            mask = np.asarray(mask_dev)
+        for slot in np.nonzero(mask)[0]:
+            self._deliver(int(slot), int(toks[slot]), first=False)
+        # publish the POST-delivery host occupancy, not the frame's entry
+        # mask: with fetch_chunk=1 the final completing frame's entry mask
+        # is >= 1 and no trailing all-inactive frame is ever dispatched —
+        # an entry-mask gauge would read busy forever at idle
+        _mx.set_gauge("serving.slots_active",
+                      sum(s is not None for s in self._slots))
+
+    def _deliver(self, slot: int, tok: int, first: bool) -> None:
+        st = self._slots[slot]
+        if st is None:
+            # a frame for a slot the host already retired would mean the
+            # device/host retirement conditions diverged — loud beats wrong
+            log.warning("engine: token for free slot %d dropped", slot)
+            return
+        st.out.append(tok)
+        _mx.inc("serving.tokens_total")
+        now = time.perf_counter()
+        if first:
+            st.t_first = now
+            st.req.ticket.t_first = now
+            _mx.observe("serving.ttft", now - st.req.ticket.t_submit)
+        done = (tok == self._eos) or (len(st.out) >= st.req.max_new)
+        if done:
+            # avg time-between-tokens over the request's decode phase (the
+            # chunked fetch makes per-token host deltas bursty; the
+            # request-level mean is the honest figure)
+            if len(st.out) > 1 and st.t_first is not None:
+                _mx.observe("serving.tbt",
+                            (now - st.t_first) / (len(st.out) - 1))
+            st.req.ticket._tokens = st.out
+            st.req.ticket._done.set()
+            with self._cond:
+                self._slots[slot] = None
+                # a stop() may have reset the free list already — don't
+                # re-add the slot on top of the reset
+                if not self._stopping:
+                    self._free.append(slot)
+                self._cond.notify_all()
+            _mx.inc("serving.engine.completions")
+
+    def _fail_outstanding(self, err: BaseException) -> None:
+        with self._cond:
+            reqs = list(self._waiting)
+            self._waiting.clear()
+            slots = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.n_slots
+            self._free = list(range(self.n_slots))
+        # last-value-wins gauges would otherwise report the pre-crash
+        # depth/occupancy forever
+        _mx.set_gauge("serving.engine.queue", 0)
+        _mx.set_gauge("serving.slots_active", 0)
+        for r in reqs:
+            r.ticket._error = err
+            r.ticket._done.set()
+        for s in slots:
+            s.req.ticket._error = err
+            s.req.ticket._done.set()

@@ -63,12 +63,16 @@ class FedMLInferenceRunner:
                     self._send(404, {"error": f"no route {self.path}"})
                     return
                 # queue depth = requests in flight on the threading server
-                # (each request holds a thread; the predictor serializes
-                # device work through jit, so depth > 1 means queueing)
+                # (each request holds a thread; a per-request predictor
+                # serializes device work through jit so depth > 1 means
+                # queueing; an engine-backed predictor blocks each request
+                # on its own ticket instead, so depth counts slots+queue).
+                # AtomicCounter with the gauge bound: += on a
+                # ThreadingHTTPServer would race and drift permanently, and
+                # publishing the gauge outside the counter's lock would let
+                # two finishing threads reorder their writes.
                 t0 = time.perf_counter()
-                with runner._inflight_lock:
-                    runner._inflight += 1
-                    _mx.set_gauge("serving.queue_depth", runner._inflight)
+                runner._inflight.inc()
                 _mx.inc("serving.requests")
                 try:
                     with recorder.span("serving.request", path=self.path):
@@ -81,12 +85,22 @@ class FedMLInferenceRunner:
                 except Exception as e:  # noqa: BLE001 — surface to caller
                     log.exception("predict failed")
                     _mx.inc("serving.errors")
-                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    # input errors are the CLIENT's (400); anything else is
+                    # this replica failing (500). The split matters to the
+                    # gateway both ways: a 4xx never kills a replica (so
+                    # hostile input can't drain the pool), and internal
+                    # failures must be 5xx so failover happens. Only the
+                    # dedicated InvalidRequest (raised at the predictors'
+                    # validation sites) and a missing-field KeyError count
+                    # as client errors — matching builtin ValueError/
+                    # TypeError would misfile internal JAX shape errors.
+                    from .predictor import InvalidRequest
+
+                    client_err = isinstance(e, (InvalidRequest, KeyError))
+                    self._send(400 if client_err else 500,
+                               {"error": f"{type(e).__name__}: {e}"})
                 finally:
-                    with runner._inflight_lock:
-                        runner._inflight -= 1
-                        _mx.set_gauge("serving.queue_depth",
-                                      runner._inflight)
+                    runner._inflight.dec()
                     _mx.observe("serving.request_s",
                                 time.perf_counter() - t0)
 
@@ -94,8 +108,7 @@ class FedMLInferenceRunner:
         self.port = self._server.server_address[1]  # resolved when port=0
         self._thread: Optional[threading.Thread] = None
         self._serving = False
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight = _mx.AtomicCounter(gauge="serving.queue_depth")
 
     def run(self) -> None:
         log.info("serving on :%d (/predict, /ready)", self.port)
@@ -115,3 +128,8 @@ class FedMLInferenceRunner:
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # an engine-backed predictor owns a decode thread — shut it down
+        # with the HTTP surface so replicas stop cleanly
+        stop = getattr(self.predictor, "stop", None)
+        if callable(stop):
+            stop()

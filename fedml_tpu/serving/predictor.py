@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Optional, Protocol
 
 import jax
@@ -35,6 +36,25 @@ from ..utils import metrics as _mx
 from ..utils.events import recorder
 
 Pytree = Any
+
+
+class InvalidRequest(ValueError):
+    """Client-side request error. The HTTP layer maps this (plus missing-
+    field KeyErrors) to 400; every OTHER exception is a 500. The split
+    matters twice over at the gateway: a 4xx must never kill a healthy
+    replica (hostile input can't take replicas out of rotation), and a
+    genuine internal failure must be a 5xx so failover actually happens —
+    classifying by builtin ValueError/TypeError would misfile internal
+    JAX shape/dtype errors as client errors."""
+
+
+def _req_int(input_json: dict, key: str, default) -> int:
+    try:
+        return int(input_json.get(key, default))
+    except (TypeError, ValueError):
+        raise InvalidRequest(
+            f"{key} must be an integer; got {input_json.get(key)!r}"
+        ) from None
 
 
 class Predictor(Protocol):
@@ -69,6 +89,27 @@ class _InstrumentedPredictor:
         return out
 
 
+def lm_predictor_from_serve_knobs(sv: dict, model, params,
+                                  adapters=None, detokenize=None,
+                                  default_max_len: int = 256
+                                  ) -> "GreedyLMPredictor":
+    """THE serve-knob -> GreedyLMPredictor mapping (decode_slots,
+    engine_max_len, engine_eos_id, engine_fetch_chunk, sampler_cache_size,
+    kv_cache), shared by the config route (serving.lm_predictor_from_config
+    reads Config.serve_args.extra) and the deploy route
+    (scheduler.start_replica reads the spec's serve dict) — one mapping,
+    so the two surfaces cannot drift."""
+    eos = sv.get("engine_eos_id")
+    return GreedyLMPredictor(
+        model, params, adapters=adapters, detokenize=detokenize,
+        max_len=int(sv.get("engine_max_len", default_max_len)),
+        kv_cache=bool(sv.get("kv_cache", True)),
+        decode_slots=int(sv.get("decode_slots", 0)),
+        eos_id=None if eos is None else int(eos),
+        engine_fetch_chunk=int(sv.get("engine_fetch_chunk", 2)),
+        sampler_cache_size=int(sv.get("sampler_cache_size", 4)))
+
+
 def _bucket(n: int, pow2_cap: int = 1024) -> int:
     """Power-of-two buckets up to the cap, then multiples of the cap — every
     batch size maps to a bounded set of compiled programs."""
@@ -100,7 +141,11 @@ class JaxPredictor(_InstrumentedPredictor):
         self._fwd = fwd
 
     def _predict(self, input_json: dict) -> tuple[dict, tuple]:
-        x = np.asarray(input_json["inputs"], np.float32)
+        try:
+            x = np.asarray(input_json["inputs"], np.float32)
+        except (TypeError, ValueError):
+            raise InvalidRequest(
+                "inputs must be a rectangular numeric array") from None
         n = x.shape[0]
         b = _bucket(n)
         if b > n:
@@ -132,19 +177,37 @@ class GreedyLMPredictor(_InstrumentedPredictor):
     (llm/decode.py): O(D² + T·D) per token instead of O(T·D²), computed
     in the params' own dtype so numerics match the recompute path (same
     tokens; parity-pinned). Prompts are bucketed and the real length
-    rides traced, so the compile cache stays bounded on both paths."""
+    rides traced, so the compile cache stays bounded on both paths.
+
+    decode_slots=S (requires kv_cache=True) additionally starts the
+    continuous-batching DecodeEngine (serving/engine.py): S slots share
+    one persistent donated KV cache and concurrent requests decode in the
+    SAME device steps instead of serializing — single-prompt requests
+    without top_k route there (greedy output token-identical to the
+    per-request path); batched and top_k requests keep the per-request
+    path. stop() shuts the engine down."""
 
     def __init__(self, model, params: Pytree,
                  detokenize: Optional[Callable[[list[int]], str]] = None,
                  max_len: int = 256, kv_cache: bool = False,
                  adapters: Optional[Pytree] = None,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 decode_slots: int = 0, eos_id: Optional[int] = None,
+                 sampler_cache_size: int = 4, engine_fetch_chunk: int = 2):
         self.model = model
         self.params = params
         self.detokenize = detokenize
         self.max_len = max_len
         self.kv_cache = kv_cache
         self.adapters = adapters
+        self.engine = None
+        self.eos_id = eos_id
+
+        if decode_slots and not kv_cache:
+            raise ValueError(
+                "decode_slots (the continuous-batching engine, "
+                "serving/engine.py) needs kv_cache=True — the engine IS "
+                "the KV-cached decode with a slot axis")
 
         if adapters is not None and not kv_cache:
             # the recompute path drives model.apply, which knows nothing of
@@ -209,12 +272,30 @@ class GreedyLMPredictor(_InstrumentedPredictor):
 
             self._generate_kv = generate_kv
             self._kv_dtype = kv_dtype
-            self._samplers: dict = {}   # top_k -> jitted sampling generate
+            # top_k -> jitted sampling generate, LRU-BOUNDED: a hostile or
+            # merely diverse stream of top_k values would otherwise grow
+            # one jitted wrapper (and its compile cache) per bucket without
+            # limit. Evicting the oldest drops its XLA executables with it;
+            # evictions are counted so a thrashing cache is visible.
+            self._samplers: "OrderedDict[int, Any]" = OrderedDict()
+            self._samplers_cap = max(1, int(sampler_cache_size))
             # FedMLInferenceRunner serves via ThreadingHTTPServer, so two
             # first requests for the same top_k bucket can race here; without
             # the lock each would build + jit its own generate wrapper — a
             # duplicate multi-minute XLA compile at large model scale
             self._samplers_lock = threading.Lock()
+            if decode_slots:
+                # continuous batching (serving/engine.py): S slots share
+                # one persistent donated KV cache; requests stream through
+                # the engine thread instead of serializing on this
+                # predictor's jit calls
+                from .engine import DecodeEngine
+
+                self.engine = DecodeEngine(
+                    model, self.params, adapters=self.adapters,
+                    n_slots=int(decode_slots), max_len=max_len,
+                    eos_id=eos_id, dtype=kv_dtype,
+                    fetch_chunk=engine_fetch_chunk).start()
             return
 
         # n_steps is a Python int at trace time (scan length must be
@@ -235,22 +316,94 @@ class GreedyLMPredictor(_InstrumentedPredictor):
 
         self._generate = generate
 
+    def stop(self) -> None:
+        """Shut down the continuous-batching engine, if one was started."""
+        if self.engine is not None:
+            self.engine.stop()
+
     def _predict(self, input_json: dict) -> tuple[dict, tuple]:
         raw = input_json["tokens"]
         # {"tokens": [[...], [...]]} = a BATCH of prompts decoded in
         # lockstep through one program (kv_cache only; rows may differ in
         # length); {"tokens": [...]} = one prompt
         batched = bool(raw) and isinstance(raw[0], (list, tuple))
-        rows = [[int(t) for t in r] for r in (raw if batched else [raw])]
+        try:
+            rows = [[int(t) for t in r]
+                    for r in (raw if batched else [raw])]
+            temperature = float(input_json.get("temperature", 0.0))
+            knobs = [k for k in ("top_k", "seed")
+                     if int(input_json.get(k) or 0) != 0]
+        except (TypeError, ValueError):
+            raise InvalidRequest(
+                "tokens must be integers and temperature/top_k/seed "
+                "numeric") from None
         if not rows or any(not r for r in rows):
-            raise ValueError("tokens must contain at least one prompt token"
-                             " (per row, for a batch)")
+            raise InvalidRequest(
+                "tokens must contain at least one prompt token"
+                " (per row, for a batch)")
         if batched and not self.kv_cache:
-            raise ValueError(
+            raise InvalidRequest(
                 "batched prompts need kv_cache=True (the recompute path "
                 "decodes one prompt per program)")
         toks = max(rows, key=len)     # longest row drives capacity checks
-        new = int(input_json.get("max_new_tokens", 16))
+        new = _req_int(input_json, "max_new_tokens", 16)
+        # a knob at its documented disabled default (top_k=0, seed=0) is
+        # equivalent to omitting it — client SDKs that serialize defaults
+        # must not be rejected on greedy requests
+        if (temperature > 0 or knobs) and not self.kv_cache:
+            raise InvalidRequest(
+                "sampling (temperature/top_k/seed) needs kv_cache=True; "
+                "the recompute path is greedy-only")
+        if temperature <= 0 and knobs:
+            raise InvalidRequest(
+                f"{'/'.join(knobs)} only apply when temperature > 0 "
+                "(temperature omitted or 0 means greedy decoding — the "
+                "knobs would be silently ignored)")
+        # continuous-batching route (serving/engine.py): single prompts
+        # without a top_k cutoff stream through the slot engine — the
+        # request blocks on its ticket while OTHER requests decode in the
+        # same device steps. Batched rows (already one program) and top_k
+        # requests (need a static-k compiled cutoff) stay on the
+        # per-request path. Engine capacity is exact (prompt + max_new <=
+        # max_len — no step bucketing), checked by submit().
+        if (self.engine is not None and not batched
+                and int(input_json.get("top_k", 0) or 0) == 0):
+            seed = int(input_json["seed"]) if "seed" in input_json else None
+            gen = None
+            try:
+                # engine stopped/died (at submit, or mid-flight after
+                # admission — the crash handler errors live tickets):
+                # degrade to the per-request path below instead of erroring
+                # the request — the replica keeps serving, just without
+                # batching. A ticket TIMEOUT is not degraded: 600s have
+                # already passed, re-decoding would double it.
+                gen = self.engine.submit(
+                    rows[0], max(new, 1), temperature=temperature,
+                    seed=seed).result(timeout=600.0)[:new]
+            except RuntimeError:
+                # Degrade ONLY when the per-request path honors the same
+                # contract the engine did; otherwise surface the failure
+                # (a 500; the gateway fails the replica over):
+                # - seeded sampling: the per-request rng schedule differs,
+                #   same seed would return different tokens with no signal
+                # - engine_eos_id: the per-request path has no eos support,
+                #   degraded output would include post-eos tokens
+                # - engine-only capacity: prompt + bucket(max_new) over
+                #   max_len would turn a previously-valid request into a
+                #   permanent, misleading 400
+                if ((temperature > 0 and seed is not None)
+                        or self.eos_id is not None
+                        or len(rows[0]) + _bucket(max(new, 1),
+                                                  pow2_cap=self.max_len)
+                        > self.max_len):
+                    raise
+            if gen is not None:
+                out = {"generated_tokens": gen}
+                if self.detokenize is not None:
+                    out["generated_text"] = self.detokenize(gen)
+                return out, ("engine",
+                             min(_bucket(len(toks), pow2_cap=self.max_len),
+                                 self.max_len))
         # fixed-size buffer + bucketed step count => a BOUNDED set of
         # compiled programs (log2(max_len) step buckets). The capacity
         # contract is prompt + bucket(max_new_tokens) <= max_len — clamping
@@ -259,26 +412,11 @@ class GreedyLMPredictor(_InstrumentedPredictor):
         # near the buffer edge.
         steps = _bucket(max(new, 1), pow2_cap=self.max_len)
         if len(toks) + steps > self.max_len:
-            raise ValueError(
+            raise InvalidRequest(
                 f"prompt {len(toks)} + max_new_tokens {new} (bucketed to "
                 f"{steps} decode steps) exceeds max_len {self.max_len}; "
                 "shorten the prompt, lower max_new_tokens, or raise "
                 "max_len")
-        temperature = float(input_json.get("temperature", 0.0))
-        # a knob at its documented disabled default (top_k=0, seed=0) is
-        # equivalent to omitting it — client SDKs that serialize defaults
-        # must not be rejected on greedy requests
-        knobs = [k for k in ("top_k", "seed")
-                 if int(input_json.get(k) or 0) != 0]
-        if (temperature > 0 or knobs) and not self.kv_cache:
-            raise ValueError(
-                "sampling (temperature/top_k/seed) needs kv_cache=True; "
-                "the recompute path is greedy-only")
-        if temperature <= 0 and knobs:
-            raise ValueError(
-                f"{'/'.join(knobs)} only apply when temperature > 0 "
-                "(temperature omitted or 0 means greedy decoding — the "
-                "knobs would be silently ignored)")
         if self.kv_cache:
             pbucket = min(_bucket(len(toks), pow2_cap=self.max_len),
                           self.max_len)
@@ -305,14 +443,16 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 top_k = int(input_json.get("top_k", 0))
                 vocab = int(self.model.vocab_size)
                 if top_k < 0 or top_k > vocab:
-                    raise ValueError(
+                    raise InvalidRequest(
                         f"top_k must be in [0, vocab_size={vocab}]; got "
                         f"{top_k} (0 disables the cutoff)")
                 if top_k:
                     top_k = min(_bucket(top_k, pow2_cap=vocab), vocab)
                 with self._samplers_lock:
                     gen = self._samplers.get(top_k)
-                    if gen is None:
+                    if gen is not None:
+                        self._samplers.move_to_end(top_k)  # LRU touch
+                    else:
                         from ..llm.decode import make_generate
 
                         kv_gen = make_generate(self.model.n_heads,
@@ -327,6 +467,12 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                                           temperature=temp)
 
                         self._samplers[top_k] = gen
+                        while len(self._samplers) > self._samplers_cap:
+                            # evict coldest bucket — its jitted wrapper
+                            # (and compiled programs) go with it; visible
+                            # as a counter so thrash is diagnosable
+                            self._samplers.popitem(last=False)
+                            _mx.inc("serving.sampler_evictions")
                 # no client seed -> a fresh one per request, so repeated
                 # sampling requests VARY (the normal serving contract);
                 # pass "seed" explicitly for reproducible generations

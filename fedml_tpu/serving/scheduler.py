@@ -71,6 +71,31 @@ def start_replica(spec: dict):
         runner.start()
         return uuid.uuid4().hex[:10], runner
 
+    if spec.get("model_kind") == "lm":
+        # LLM replica: llm/TransformerLM + GreedyLMPredictor. "lm" carries
+        # the model recipe, "serve" the ServeArgs.extra knobs (config.py) —
+        # decode_slots > 0 brings the replica up on the continuous-batching
+        # engine (serving/engine.py), otherwise per-request decode.
+        from ..llm.transformer import TransformerLM
+        from .predictor import lm_predictor_from_serve_knobs
+
+        lm = dict(spec.get("lm", {}))
+        model = TransformerLM(
+            vocab_size=int(lm["vocab_size"]),
+            d_model=int(lm["d_model"]), n_layers=int(lm["n_layers"]),
+            n_heads=int(lm["n_heads"]), d_ff=int(lm["d_ff"]),
+            scan_layers=bool(lm.get("scan_layers", False)))
+        # serve knobs go through the SAME mapping as the config route
+        # (predictor.lm_predictor_from_serve_knobs) — one source of
+        # defaults, the two surfaces cannot drift
+        pred = lm_predictor_from_serve_knobs(
+            dict(spec.get("serve", {})), model, spec["params"],
+            adapters=spec.get("adapters"),
+            default_max_len=int(lm.get("max_len", 256)))
+        runner = FedMLInferenceRunner(pred, port=int(spec.get("port", 0)))
+        runner.start()
+        return uuid.uuid4().hex[:10], runner
+
     model = model_hub.create(spec["model"], int(spec.get("num_classes", 10)),
                              **dict(spec.get("model_args", {})))
     apply_fn = model_hub.mixed_precision_apply(
@@ -232,13 +257,17 @@ class InferenceGateway:
 
     def __init__(self, deployment: Deployment, host: str = "127.0.0.1",
                  port: int = 0, high_water: float = 2.0,
-                 low_water: float = 0.25, scale_interval: float = 0.5):
+                 low_water: float = 0.25, scale_interval: float = 0.5,
+                 retry_backoff_s: float = 0.05):
         self.dep = deployment
-        self.inflight = 0
-        self._inflight_lock = threading.Lock()
+        # AtomicCounter (utils/metrics.py): += on the threading server
+        # would race and drift the autoscaler's load signal; the gauge is
+        # bound so it publishes under the counter's own lock
+        self._inflight = _mx.AtomicCounter(gauge="serving.gateway_inflight")
         self.high_water = high_water
         self.low_water = low_water
         self.scale_interval = scale_interval
+        self.retry_backoff_s = retry_backoff_s
         self._stop = threading.Event()
         gateway = self
 
@@ -276,23 +305,21 @@ class InferenceGateway:
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
-                with gateway._inflight_lock:
-                    gateway.inflight += 1
-                    _mx.set_gauge("serving.gateway_inflight",
-                                  gateway.inflight)
+                gateway._inflight.inc()
                 try:
                     code, payload = gateway.forward(body)
                     self._send(code, payload)
                 finally:
-                    with gateway._inflight_lock:
-                        gateway.inflight -= 1
-                        _mx.set_gauge("serving.gateway_inflight",
-                                      gateway.inflight)
+                    gateway._inflight.dec()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
         self._scaler: Optional[threading.Thread] = None
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight.value()
 
     # ---------------------------------------------------------- routing
     def forward(self, body: bytes, tries: int = 3) -> tuple[int, dict]:
@@ -307,7 +334,13 @@ class InferenceGateway:
                         time.perf_counter() - t0)
 
     def _forward(self, body: bytes, tries: int) -> tuple[int, dict]:
-        for _ in range(tries):
+        for attempt in range(tries):
+            if attempt:
+                # short exponential backoff between failover attempts — a
+                # replacement replica needs a beat to come READY, and
+                # hammering the next pick during a correlated outage just
+                # burns the retry budget in microseconds
+                time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
             rep = self.dep.pick()
             if rep is None:
                 return 503, {"error": "no ready replicas"}
@@ -318,12 +351,22 @@ class InferenceGateway:
                 with urllib.request.urlopen(req, timeout=30) as r:
                     return r.status, json.loads(r.read() or b"{}")
             except urllib.error.HTTPError as e:
-                # the replica is alive and rejected the request (bad input):
-                # surface the error, don't kill the replica
-                try:
-                    return e.code, json.loads(e.read() or b"{}")
-                except (json.JSONDecodeError, OSError):
-                    return e.code, {"error": f"replica returned {e.code}"}
+                if e.code < 500:
+                    # the replica is alive and rejected the request (bad
+                    # input): surface the error, don't kill the replica —
+                    # a client-side 4xx must never take a healthy replica
+                    # out of rotation
+                    try:
+                        return e.code, json.loads(e.read() or b"{}")
+                    except (json.JSONDecodeError, OSError):
+                        return e.code, {"error": f"replica returned {e.code}"}
+                # 5xx: the replica itself is failing — treat like a
+                # transport error: mark DEAD, heal, retry elsewhere
+                log.warning("replica %s returned %d; rerouting",
+                            rep.replica_id, e.code)
+                _mx.inc("serving.gateway_failovers")
+                self.dep.mark_dead(rep)
+                self.dep.reap_and_heal()
             except (urllib.error.URLError, OSError, json.JSONDecodeError):
                 log.warning("replica %s unreachable; rerouting",
                             rep.replica_id)
@@ -336,8 +379,7 @@ class InferenceGateway:
     def _scale_loop(self) -> None:
         while not self._stop.wait(self.scale_interval):
             ready = len(self.dep.ready_replicas())
-            with self._inflight_lock:
-                load = self.inflight
+            load = self._inflight.value()
             if ready == 0:
                 self.dep.reap_and_heal()
             elif load / ready > self.high_water:

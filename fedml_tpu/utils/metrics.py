@@ -76,6 +76,36 @@ class Counter:
             return self._base + sum(b[0] for _, b in self._shards)
 
 
+class AtomicCounter:
+    """Lock-protected up/down counter for in-flight accounting (serving
+    queue depth, gateway inflight). Unlike Counter (monotonic, per-thread
+    shards merged at read) this is ONE value mutated under a lock.
+    `gauge` binds a registry gauge that is updated INSIDE the same lock —
+    publishing the post-update value outside it would let two finishing
+    threads reorder their gauge writes and leave a phantom depth behind."""
+
+    __slots__ = ("_value", "_lock", "_gauge")
+
+    def __init__(self, initial: int = 0, gauge: Optional[str] = None):
+        self._value = int(initial)
+        self._lock = threading.Lock()
+        self._gauge = gauge
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            if self._gauge is not None:
+                registry.gauge(self._gauge).set(self._value)
+            return self._value
+
+    def dec(self, n: int = 1) -> int:
+        return self.inc(-n)
+
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
 class Gauge:
     """Last-value-wins gauge (queue depth, cache size). Plain attribute
     assignment — atomic under the GIL, no shards needed."""

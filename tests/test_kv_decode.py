@@ -372,6 +372,47 @@ def test_batched_decode_matches_per_row_generation():
     assert np.asarray(got).tolist() == want
 
 
+def test_batched_sampling_matches_per_row_generation():
+    """Sampling analog of the greedy batched test: with PER-ROW rng keys
+    ([B] key array) every batched row must draw the exact tokens decoding
+    that prompt alone with its own key would — per-row gumbel streams, not
+    one [B, V] field (seeded; rows of different real lengths)."""
+    from fedml_tpu.llm.decode import make_generate
+
+    _m, params, ads, _ra, _rads, _t = _setup(True, True)
+    rs = np.random.RandomState(5)
+    rows = [rs.randint(1, V, n).tolist() for n in (6, 10, 8)]
+    n_new = 5
+    temp = jnp.float32(1.5)
+    jgen = jax.jit(make_generate(H, sample=True), static_argnums=(3, 4))
+    keys = jax.random.split(jax.random.key(42), len(rows))
+
+    want = []
+    for i, r in enumerate(rows):
+        got = jgen(params, ads, jnp.asarray([r], jnp.int32), MAXLEN, n_new,
+                   rng=keys[i:i + 1], temperature=temp)
+        want.append(np.asarray(got).tolist())
+
+    pb = 16
+    padded = np.zeros((len(rows), pb), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, : len(r)] = r
+    lengths = jnp.asarray([len(r) for r in rows], jnp.int32)
+    got = jgen(params, ads, jnp.asarray(padded), MAXLEN, n_new,
+               length=lengths, rng=keys, temperature=temp)
+    assert np.asarray(got).tolist() == want
+    # and the single-key form still works (shared stream, batch shape)
+    shared = jgen(params, ads, jnp.asarray(padded), MAXLEN, n_new,
+                  length=lengths, rng=jax.random.key(42), temperature=temp)
+    assert np.asarray(shared).shape == (3, n_new)
+    # a LEGACY uint32[2] PRNGKey (ndim 1 but NOT a key array) must route
+    # to the shared-stream path, not crash in the per-row vmap
+    legacy = jgen(params, ads, jnp.asarray(padded), MAXLEN, n_new,
+                  length=lengths, rng=jax.random.PRNGKey(42),
+                  temperature=temp)
+    assert np.asarray(legacy).tolist() == np.asarray(shared).tolist()
+
+
 def test_predictor_batched_request():
     from fedml_tpu.serving.predictor import GreedyLMPredictor
 

@@ -187,6 +187,101 @@ def test_deploy_gateway_failover_e2e():
         release_router(run_id)
 
 
+class _CodeHandler:
+    """Tiny HTTP server whose /predict always answers a fixed code."""
+
+    def __init__(self, code: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        status = code
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"code": status}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class _StubDep:
+    """Duck-typed Deployment: deterministic pick (first READY), counts
+    heals."""
+
+    def __init__(self, reps):
+        self.reps = reps
+        self.healed = 0
+
+    def ready_replicas(self):
+        return [r for r in self.reps if r.state == "READY"]
+
+    def pick(self):
+        ready = self.ready_replicas()
+        return ready[0] if ready else None
+
+    def mark_dead(self, rep):
+        rep.state = "DEAD"
+
+    def reap_and_heal(self):
+        self.healed += 1
+
+
+def test_gateway_4xx_keeps_replica_5xx_fails_over_with_backoff():
+    """Failover policy (ISSUE 5 satellite): a client-side 4xx must NOT
+    kill a healthy replica; a 5xx marks it DEAD and the request retries
+    elsewhere — after a short backoff, not immediately."""
+    from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
+
+    servers = [_CodeHandler(500), _CodeHandler(400), _CodeHandler(200)]
+    reps = []
+    for i, s in enumerate(servers):
+        r = _Replica(f"job{i}")
+        r.replica_id = f"rep{i}"
+        r.endpoint = f"http://127.0.0.1:{s.port}"
+        r.state = "READY"
+        reps.append(r)
+    bad5, bad4, good = reps
+    try:
+        # 4xx: surfaced to the caller, replica stays READY, no heal
+        dep = _StubDep([bad4, good])
+        gw = InferenceGateway(dep, retry_backoff_s=0.1)
+        code, payload = gw._forward(b"{}", tries=3)
+        assert code == 400 and payload == {"code": 400}
+        assert bad4.state == "READY" and dep.healed == 0
+        gw._server.server_close()
+
+        # 5xx: replica dies, request fails over to the survivor — and the
+        # second attempt waited for the backoff
+        dep = _StubDep([bad5, good])
+        gw = InferenceGateway(dep, retry_backoff_s=0.1)
+        t0 = time.monotonic()
+        code, payload = gw._forward(b"{}", tries=3)
+        elapsed = time.monotonic() - t0
+        assert code == 200 and payload == {"code": 200}
+        assert bad5.state == "DEAD" and dep.healed == 1
+        assert good.state == "READY"
+        assert elapsed >= 0.09, f"no backoff between attempts ({elapsed})"
+        gw._server.server_close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
 def test_autoscaler_scales_up_under_load():
     from fedml_tpu.serving.scheduler import InferenceGateway
 
