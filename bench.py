@@ -542,6 +542,93 @@ def bench_serving_cb(quick: bool = False) -> dict:
     }
 
 
+def _serving_tp_child() -> int:
+    """Child half of bench_serving_tp: runs in a SUBPROCESS whose host
+    platform is forced to 2 CPU devices (XLA_FLAGS must be set before jax
+    initializes, which the parent process's jax already did). Measures
+    engine decode tok/s with no mesh (mp=1) and on an {"mp": 2} mesh —
+    weights + persistent KV cache sharded through the
+    parallel/partition.py registry — asserts greedy token identity
+    between the two, and prints one JSON line."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.llm.transformer import TransformerLM
+    from fedml_tpu.parallel.mesh import make_mesh
+    from fedml_tpu.serving.engine import DecodeEngine
+
+    conc, new = 8, 16
+    dims = dict(vocab_size=256, d_model=256, n_layers=2, n_heads=8,
+                d_ff=512)
+    model = TransformerLM(**dims, scan_layers=True)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, dims["vocab_size"], n).tolist()
+               for n in (10, 14, 12, 9, 16, 11, 13, 15)]
+
+    def run(mesh):
+        eng = DecodeEngine(model, params, n_slots=conc, max_len=64,
+                           mesh=mesh).start()
+        try:
+            eng.submit(prompts[0], new).result(timeout=300)   # compile
+            best, toks = 0.0, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                tickets = [eng.submit(p, new) for p in prompts]
+                outs = [t.result(timeout=300) for t in tickets]
+                best = max(best, conc * new / (time.perf_counter() - t0))
+                toks = outs
+        finally:
+            eng.stop()
+        return best, toks
+
+    tps1, toks1 = run(None)
+    tps2, toks2 = run(make_mesh({"mp": 2}))
+    print(json.dumps({
+        "devices": len(jax.devices()),
+        "tps_mp1": round(tps1, 1), "tps_mp2": round(tps2, 1),
+        "tokens_identical": toks1 == toks2,
+        "config": (f"conc{conc} new{new} d{dims['d_model']} "
+                   f"L{dims['n_layers']} H{dims['n_heads']} maxlen64"),
+    }))
+    return 0
+
+
+def bench_serving_tp() -> dict:
+    """Tensor-parallel serving row (ISSUE 6): DecodeEngine tok/s at mp=1
+    vs mp=2 on a FORCED-2-device CPU host (subprocess — the flag only
+    takes effect before jax initializes), with greedy token identity
+    asserted between the two. On CPU the two "devices" share the same
+    socket, so mp=2 pays collective overhead with no extra FLOP/s — the
+    honest expectation here is scaling ~<=1x and TOKENS IDENTICAL; on a
+    real v5e slice the same program gains the chips' HBM bandwidth and
+    the multichip rung expects tok/s to scale with chip count (and
+    13B-class KV+weights to fit where one chip OOMs)."""
+    import subprocess
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--serving-tp-child"],
+        capture_output=True, text=True, timeout=1200, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"serving_tp child failed: {r.stderr[-300:]}")
+    child = json.loads(r.stdout.strip().splitlines()[-1])
+    return {
+        "serving_tp_tokens_per_sec_mp1": child["tps_mp1"],
+        "serving_tp_tokens_per_sec_mp2": child["tps_mp2"],
+        "serving_tp_scaling_mp2_vs_mp1": round(
+            child["tps_mp2"] / child["tps_mp1"], 2),
+        "serving_tp_tokens_identical": child["tokens_identical"],
+        "serving_tp_config": (
+            child["config"] + " cpu-forced-2dev; TPU expectation: tok/s "
+            "scales with chip count (multichip rung)"),
+    }
+
+
 def bench_workload4_hierarchical() -> dict:
     """BASELINE workload 4: hierarchical cross-silo — per-silo inner
     allreduce (intra axis) + outer aggregate (silos axis), one XLA program
@@ -1106,6 +1193,9 @@ _HEADLINE_KEYS = (
     # continuous-batching serving (ISSUE 5): concurrency-8 decode row
     "serving_cb_speedup_vs_per_request", "serving_cb_tokens_per_sec",
     "serving_cb_ttft_p50_ms",
+    # tensor-parallel serving (ISSUE 6): mp=1 vs mp=2 engine row
+    "serving_tp_scaling_mp2_vs_mp1", "serving_tp_tokens_per_sec_mp2",
+    "serving_tp_tokens_identical",
     "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",
@@ -1163,6 +1253,11 @@ def main():
                {"w1_reliable_comm_error": "bench_reliable_comm failed twice"})
     acc.update(_retrying(bench_serving_cb, quick, default=None) or
                {"serving_cb_error": "bench_serving_cb failed twice"})
+    if not quick:
+        # fresh-interpreter subprocess (forced-2-device jax cold start +
+        # two engine compiles) — too heavy for the quick lane
+        acc.update(_retrying(bench_serving_tp, default=None) or
+                   {"serving_tp_error": "bench_serving_tp failed twice"})
     if not quick:
         acc.update(_retrying(bench_workload4_hierarchical, default=None) or
                    {"w4_error": "bench_workload4 failed twice"})
@@ -1241,4 +1336,8 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--serving-tp-child" in sys.argv:
+        # forced-2-device subprocess entry (bench_serving_tp) — must run
+        # before any other bench code touches jax
+        sys.exit(_serving_tp_child() or 0)
     sys.exit(main() or 0)

@@ -716,6 +716,81 @@ def cmd_diagnosis(args) -> int:
         return {"requests": 8, "max_slots_active": max_active[0],
                 "programs": counts}
 
+    def partition_rules_smoke():
+        # the partitioning plane end-to-end (ISSUE 6): build the registry,
+        # resolve the flagship TransformerLM in its serving shape (scan
+        # layout + int8 base) and its LoRA adapters under the DEFAULT
+        # error policy — full coverage and no ambiguity or this raises —
+        # then build an {"mp": 2} mesh and actually shard the resolved
+        # tree onto it: in-process when this host already has >= 2
+        # devices, else in a subprocess whose host platform is FORCED to
+        # 2 devices (this process's jax is already initialized, so the
+        # forced-device flag must be set before a fresh interpreter boots)
+        import os as _os
+        import subprocess as _sp
+        import sys as _sys
+        from pathlib import Path as _Path
+
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from .llm.lora import lora_init
+        from .llm.quant import quantize_tree_int8
+        from .llm.transformer import TransformerLM
+        from .parallel import partition as part
+
+        model = TransformerLM(vocab_size=64, d_model=32, n_layers=2,
+                              n_heads=2, d_ff=64, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        specs = part.resolve("transformer_lm", quantize_tree_int8(params))
+        part.resolve("lora", lora_init(_jax.random.key(1), params, rank=2))
+        if len(_jax.devices()) >= 2:
+            # this process already has a multi-device platform (real TPU
+            # slice, or a test run under the forced-device conftest):
+            # shard in-process — no ~15s subprocess jax cold-start
+            from .parallel.mesh import make_mesh
+
+            sh = part.shard_params(params, make_mesh({"mp": 2}),
+                                   "transformer_lm")
+            wq = sh["blocks"]["wq"]["kernel"]
+            if len(wq.sharding.device_set) != 2:
+                raise RuntimeError(f"wq not sharded: {wq.sharding}")
+            return {"resolved_params":
+                    len(_jax.tree_util.tree_leaves(specs)),
+                    "devices": len(_jax.devices()),
+                    "wq_spec": str(wq.sharding.spec),
+                    "mode": "in-process"}
+        child = (
+            "import json, jax, jax.numpy as jnp\n"
+            "from fedml_tpu.llm.transformer import TransformerLM\n"
+            "from fedml_tpu.parallel import partition as part\n"
+            "from fedml_tpu.parallel.mesh import make_mesh\n"
+            "m = TransformerLM(vocab_size=64, d_model=32, n_layers=2,\n"
+            "                  n_heads=2, d_ff=64, scan_layers=True)\n"
+            "p = m.init(jax.random.key(0),\n"
+            "           jnp.zeros((1, 8), jnp.int32))['params']\n"
+            "sh = part.shard_params(p, make_mesh({'mp': 2}),\n"
+            "                       'transformer_lm')\n"
+            "wq = sh['blocks']['wq']['kernel']\n"
+            "assert len(wq.sharding.device_set) == 2, wq.sharding\n"
+            "print(json.dumps({'devices': len(jax.devices()),\n"
+            "                  'wq_spec': str(wq.sharding.spec)}))\n")
+        env = {**_os.environ, "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+               "PYTHONPATH": _os.pathsep.join(
+                   [str(_Path(__file__).resolve().parent.parent)]
+                   + ([_os.environ["PYTHONPATH"]]
+                      if _os.environ.get("PYTHONPATH") else []))}
+        r = _sp.run([_sys.executable, "-c", child], capture_output=True,
+                    text=True, timeout=240, env=env)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"forced-2-device mesh child failed: {r.stderr[-300:]}")
+        mesh_child = json.loads(r.stdout.strip().splitlines()[-1])
+        return {"resolved_params": len(_jax.tree_util.tree_leaves(specs)),
+                **mesh_child, "mode": "forced-2-device subprocess"}
+
     check("jax", jax_devices)
     check("wire_codec", wire)
     check("loopback_transport", loopback)
@@ -724,9 +799,11 @@ def cmd_diagnosis(args) -> int:
     check("metrics_endpoint", metrics_endpoint)
     check("chaos_smoke", chaos_smoke)
     check("serving_engine_smoke", serving_engine_smoke)
+    check("partition_rules_smoke", partition_rules_smoke)
     required_ok = all(checks[k]["ok"] for k in
                       ("jax", "wire_codec", "loopback_transport",
-                       "chaos_smoke", "serving_engine_smoke"))
+                       "chaos_smoke", "serving_engine_smoke",
+                       "partition_rules_smoke"))
     print(json.dumps({"ok": required_ok, "checks": checks}, indent=2))
     return 0 if required_ok else 1
 

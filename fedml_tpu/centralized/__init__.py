@@ -67,6 +67,31 @@ class CentralizedTrainer:
         self.params = model_hub.init_params(
             self.model, self.dataset.x_train.shape[2:],
             jax.random.key(cfg.common_args.random_seed))
+        # model-parallel params via the ONE partition-rule registry
+        # (parallel/partition.py): a device_args.mesh_shape naming an `mp`
+        # axis shards the params with the model's rule table
+        # (device_args.partition_rules overrides the auto pick;
+        # device_args.unmatched_params opts into replicating params the
+        # table misses — the default is a hard error). The jitted epoch
+        # inherits the layout from the param inputs; optimizer state
+        # follows automatically (opt.init's zeros_like preserves
+        # shardings).
+        self.mesh = None
+        self.param_specs = None
+        mesh_shape = cfg.device_args.mesh_shape
+        if mesh_shape and "mp" in mesh_shape:
+            from ..parallel import partition
+            from ..parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(mesh_shape)
+            table = (cfg.device_args.extra.get("partition_rules")
+                     or partition.table_for_model(self.model))
+            self.param_specs = partition.resolve(
+                table, self.params, axis="mp",
+                on_unmatched=cfg.device_args.extra.get(
+                    "unmatched_params", partition.ERROR))
+            self.params = partition.shard_params(
+                self.params, self.mesh, specs=self.param_specs)
         self.pooled = {k: jnp.asarray(v)
                        for k, v in pool_clients(self.dataset).items()}
         self.opt = make_client_optimizer(
@@ -90,6 +115,19 @@ class CentralizedTrainer:
             self.apply_fn, params, self.pooled, idx, self.opt,
             objective=self.objective, opt_state=opt_state,
             return_opt_state=True)
+        if self.mesh is not None:
+            # pin the epoch's OUTPUT params to the registry layout: the
+            # compiler is otherwise free to pick its own output shardings,
+            # and the layout would drift from the resolved spec table
+            # after the first epoch (observed: a bias re-sharded to
+            # P('mp') on CPU) — breaking the "one table, one layout"
+            # contract checkpoints rely on
+            from jax.sharding import NamedSharding
+
+            params = jax.tree.map(
+                lambda p, s: jax.lax.with_sharding_constraint(
+                    p, NamedSharding(self.mesh, s)),
+                params, self.param_specs)
         return params, opt_state, (metrics.loss_sum, metrics.correct,
                                    metrics.count)
 

@@ -161,7 +161,10 @@ class ServeArgs:
       engine_eos_id     — token id that retires a slot early (omit: none)
       engine_fetch_chunk — device frames kept in flight before the host
                           fetches (dispatch-ahead depth)
-      sampler_cache_size — LRU cap on per-top_k compiled samplers"""
+      sampler_cache_size — LRU cap on per-top_k compiled samplers
+      engine_mp          — >1 runs the engine tensor-parallel over an
+                          {"mp": N} mesh (weights + persistent KV cache
+                          sharded via the parallel/partition.py registry)"""
     extra: dict = field(default_factory=dict)
 
 
@@ -331,7 +334,7 @@ class Config:
         # too — a misspelled decode_slots must not pass silently.
         _serve_knobs = {"decode_slots", "engine_max_len",
                         "engine_fetch_chunk", "engine_eos_id",
-                        "sampler_cache_size", "kv_cache"}
+                        "sampler_cache_size", "kv_cache", "engine_mp"}
         unknown = set(self.serve_args.extra) - _serve_knobs
         if unknown:
             raise ValueError(
@@ -343,7 +346,7 @@ class Config:
                 f"serve_args.kv_cache must be a boolean; got {kvc!r}")
         for knob, lo in (("decode_slots", 0), ("engine_max_len", 1),
                          ("engine_fetch_chunk", 1), ("engine_eos_id", 0),
-                         ("sampler_cache_size", 1)):
+                         ("sampler_cache_size", 1), ("engine_mp", 1)):
             val = self.serve_args.extra.get(knob)
             if val is None:
                 continue
@@ -356,6 +359,36 @@ class Config:
                 raise ValueError(
                     f"serve_args.{knob} must be an integer >= {lo}; "
                     f"got {val!r}")
+        # engine_mp only takes effect inside the engine (decode_slots > 0):
+        # a config asking for tensor-parallel serving without the engine
+        # would silently run single-chip per-request — refuse at load
+        # instead (the other engine_* knobs double as per-request knobs,
+        # e.g. engine_max_len sizes both paths, so only this one is gated)
+        mp_knob = self.serve_args.extra.get("engine_mp")
+        if mp_knob is not None and int(mp_knob) > 1 \
+                and not self.serve_args.extra.get("decode_slots"):
+            raise ValueError(
+                "serve_args.engine_mp > 1 requires decode_slots > 0 — "
+                "tensor-parallel serving runs inside the decode engine; "
+                "without slots the knob would be silently ignored")
+        # partitioning-plane knobs (parallel/partition.py): the rule-table
+        # name must exist in the registry and the unmatched policy must be
+        # a known one — a typo'd table fails at load, not as an
+        # UnmatchedParamError mid-init. The lazy import keeps config load
+        # jax-free (partition.py defers its own jax imports the same way).
+        pr = self.device_args.extra.get("partition_rules")
+        if pr is not None:
+            from .parallel.partition import TABLES
+
+            if pr not in TABLES:
+                raise ValueError(
+                    f"device_args.partition_rules must be one of "
+                    f"{sorted(TABLES)}; got {pr!r}")
+        um = self.device_args.extra.get("unmatched_params")
+        if um is not None and um not in ("error", "replicated"):
+            raise ValueError(
+                "device_args.unmatched_params must be 'error' or "
+                f"'replicated'; got {um!r}")
         # chaos plane + reliable delivery knobs (ISSUE 4): both specs are
         # parsed by their owning modules so validation never drifts from the
         # consumer; lazy imports keep config load jax-free and cycle-free.

@@ -29,15 +29,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Pytree = Any
 
-# module-name -> kernel partition spec builder (Megatron column/row layout)
-_COL = ("wq", "wk", "wv", "w_gate", "w_up")   # shard output features
-_ROW = ("wo", "w_down")                        # shard input features
+# The Megatron column/row module split (wq/wk/wv/w_gate/w_up column,
+# wo/w_down row) now lives as regex rules in parallel/partition.py
+# `transformer_lm_rules` — the one table train and serve both resolve.
 
 
 def tp_param_specs(params: Pytree, axis: str = "tp") -> Pytree:
     """PartitionSpec tree for TransformerLM params (same structure).
 
-    Understands all three base layouts:
+    DEPRECATED entry point: this is now a thin shim over the ONE
+    partition-rule registry (`parallel/partition.py` `transformer_lm`
+    table) — new code should call
+    `parallel.partition.resolve("transformer_lm", params, axis=...)`
+    directly, which is what the round programs, the CentralizedTrainer,
+    and the serving DecodeEngine consume. The shim keeps the old
+    unmatched-params-replicate behavior (`on_unmatched="replicated"`) so
+    existing callers resolve bit-identically; the registry's default is a
+    hard error.
+
+    Understands all three base layouts (now expressed as registry rules):
     - unrolled 2-D kernels [din, dout] (the table above);
     - scan-over-layers 3-D stacked kernels [L, din, dout]
       (TransformerLM(scan_layers=True)) — same Megatron split on the
@@ -48,28 +58,10 @@ def tp_param_specs(params: Pytree, axis: str = "tp") -> Pytree:
       (a row split divides din; scales are per-dout). 7B int8 over tp=8
       puts ~0.9GB of base on each chip.
     """
+    from ..parallel import partition
 
-    def spec_for(path, leaf):
-        names = [str(getattr(p, "key", "")) for p in path]
-        col = any(n in _COL for n in names)
-        row = any(n in _ROW for n in names)
-        if names and names[-1] == "s":        # quant scales [..., 1, dout]
-            return P(*([None] * (leaf.ndim - 1)), axis) if col else P()
-        if leaf.ndim == 2:
-            if col or "embed" in names or "lm_head" in names:
-                # embed [V, D] shards D; lm_head [D, V] shards V
-                return P(None, axis)
-            if row:
-                return P(axis, None)
-            return P()
-        if leaf.ndim == 3:                    # stacked [L, din, dout]
-            if col:
-                return P(None, None, axis)
-            if row:
-                return P(None, axis, None)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    return partition.resolve("transformer_lm", params, axis=axis,
+                             on_unmatched=partition.REPLICATED)
 
 
 def shard_params_tp(params: Pytree, mesh: Mesh, axis: str = "tp") -> Pytree:

@@ -17,16 +17,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(axes: Optional[dict] = None, devices=None) -> Mesh:
     """axes: ordered {name: size}; size -1 means 'all remaining devices'.
-    Default: 1-D mesh over all devices on axis `clients`."""
+    Default: 1-D mesh over all devices on axis `clients`. Explicit sizes
+    smaller than the device count use a prefix of the devices (a 2-chip
+    `mp` mesh on an 8-chip host is valid). Bad shapes fail HERE with the
+    offending axis named — before this validation they surfaced as a
+    numpy reshape traceback nowhere near the config that caused them."""
     devices = devices if devices is not None else jax.devices()
     axes = dict(axes or {"clients": len(devices)})
+    wild = None
+    for name, size in axes.items():
+        if isinstance(size, bool) or not isinstance(size, int):
+            raise ValueError(
+                f"mesh axis {name!r} size must be an integer (or -1 for "
+                f"'all remaining devices'); got {size!r}")
+        if size == -1:
+            if wild is not None:
+                raise ValueError(
+                    f"mesh axes {wild!r} and {name!r} are both -1; only "
+                    "one axis can absorb the remaining devices")
+            wild = name
+        elif size < 1:
+            raise ValueError(
+                f"mesh axis {name!r} size must be >= 1 or -1; got {size}")
     sizes = list(axes.values())
-    if -1 in sizes:
+    if wild is not None:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if known > len(devices) or len(devices) % known:
+            raise ValueError(
+                f"mesh {axes}: the fixed axes multiply to {known}, which "
+                f"does not divide the {len(devices)} available devices — "
+                f"axis {wild!r} (-1) cannot be sized")
         sizes[sizes.index(-1)] = len(devices) // known
     total = int(np.prod(sizes))
     if total > len(devices):
-        raise ValueError(f"mesh {axes} needs {total} devices, have {len(devices)}")
+        big = max(axes, key=lambda k: axes[k] if axes[k] != -1 else 0)
+        raise ValueError(
+            f"mesh {axes} needs {total} devices, have {len(devices)} "
+            f"(largest axis: {big!r}={axes[big]})")
     arr = np.array(devices[:total]).reshape(sizes)
     return Mesh(arr, tuple(axes.keys()))
 

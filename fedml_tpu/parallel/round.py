@@ -484,8 +484,51 @@ def build_block_fn(
 
 
 def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:
-    """device_put the stacked client arrays, sharded over the client axis."""
+    """device_put the stacked client arrays, sharded over the client axis.
+
+    The layout comes from the ONE partition-rule registry
+    (parallel/partition.py `fed_data_rules`): {"x","y","mask"} shard their
+    leading client axis over `axis`. An unexpected data key is a hard
+    error at placement time — not a silently replicated array that
+    multiplies host->device transfer by the mesh size."""
     if mesh is None:
         return {k: jnp.asarray(v) for k, v in data.items()}
-    sh = NamedSharding(mesh, P(axis))
-    return {k: jax.device_put(jnp.asarray(v), sh) for k, v in data.items()}
+    from .partition import fed_data_rules, match_partition_rules
+
+    specs = match_partition_rules(fed_data_rules(axis), data)
+    return {k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
+            for k, v in data.items()}
+
+
+def resolve_param_specs(params: Pytree, rules="transformer_lm",
+                        axis: str = "mp",
+                        on_unmatched: str = "error") -> Pytree:
+    """The TRAIN-side entry point to the partition-rule registry: the
+    PartitionSpec tree server params are laid out with. Delegates to
+    parallel/partition.resolve — the same call the serving DecodeEngine
+    makes, so the train and serve spec tables for a model cannot drift
+    (asserted identical in tests/test_partition.py). In production the
+    CentralizedTrainer consumes this plane today; the federated round
+    paths consume the registry through `shard_fed_data`, and composing an
+    `mp` axis INTO the client-sharded shard_map programs (a 2-D
+    clients x mp round) is the multichip rung this entry point exists
+    for — see ROADMAP."""
+    from .partition import resolve
+
+    return resolve(rules, params, axis=axis, on_unmatched=on_unmatched)
+
+
+def shard_server_params(params: Pytree, mesh: Mesh,
+                        rules="transformer_lm", axis: str = "mp",
+                        on_unmatched: str = "error") -> Pytree:
+    """device_put server params with registry-resolved shardings before
+    building a round program: the jitted round inherits the layout from
+    its inputs (GSPMD propagates it through broadcast/update/aggregate).
+    Works today on the NO-MESH round path (single-device clients loop, mp
+    mesh for the model); the shard_map client paths declare their
+    broadcast replicated, so wiring an mp axis into them is the pending
+    multichip-rung change, not a config flip."""
+    from .partition import shard_params
+
+    return shard_params(params, mesh, rules, axis=axis,
+                        on_unmatched=on_unmatched)

@@ -138,13 +138,21 @@ class DecodeEngine:
     max_new_tokens — the mode the greedy-equivalence contract is pinned
     in). Sampling: per-slot traced temperature; temperature <= 0 means
     greedy; full-vocab categorical (top_k requests stay on the
-    per-request path, which compiles a static-k cutoff)."""
+    per-request path, which compiles a static-k cutoff).
+
+    `mesh` (a jax Mesh with an `mp` axis) runs the engine TENSOR-PARALLEL:
+    weights and the persistent KV cache shard over `mp` via the
+    parallel/partition.py rule registry (`partition_rules` overrides the
+    default `transformer_lm` table) — the scale-out path for models whose
+    KV cache + weights exceed one chip's HBM. Greedy output is
+    token-identical across mp sizes (pinned at mp=1 vs mp=2 in tests)."""
 
     def __init__(self, model, params: Pytree,
                  adapters: Optional[Pytree] = None, *,
                  n_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
-                 dtype=None, fetch_chunk: int = 2):
+                 dtype=None, fetch_chunk: int = 2,
+                 mesh=None, partition_rules=None):
         from ..llm.decode import (
             make_kv_decode, stack_adapter_blocks, stack_blocks,
         )
@@ -166,6 +174,52 @@ class DecodeEngine:
                       if jnp.issubdtype(l.dtype, jnp.floating)]
             kv_dtype = floats[0].dtype if floats else jnp.float32
         self._kv_dtype = kv_dtype
+
+        # ------------------------------------------ tensor-parallel layout
+        # `mesh` with an `mp` axis runs the engine tensor-parallel: weights
+        # take the Megatron column/row layout from the ONE partition-rule
+        # registry (parallel/partition.py — the SAME table the round
+        # programs and CentralizedTrainer resolve, so train and serve
+        # layouts cannot drift), adapters replicate (they are the round
+        # payload), and the persistent KV cache [L, S, max_len, H, Dh]
+        # shards its HEADS axis (partition.kv_cache_spec) — the decode-side
+        # continuation of the column-split attention projections. GSPMD
+        # inserts the one all-reduce per block at the wo row matmul; with
+        # mp=1 the placement is a no-op and the engine stays token-
+        # identical to the unmeshed path (pinned in tests).
+        self.mesh = mesh
+        self.param_specs = None
+        self.kv_spec = None
+        kv_sharding = rep_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from ..parallel import partition
+
+            if "mp" not in mesh.axis_names:
+                raise ValueError(
+                    f"DecodeEngine mesh axes {mesh.axis_names} have no "
+                    "'mp' axis (the tensor-parallel axis the rule tables "
+                    "shard over)")
+            mp = mesh.shape["mp"]
+            if model.n_heads % mp:
+                raise ValueError(
+                    f"n_heads {model.n_heads} is not divisible by mp={mp}"
+                    " — the KV cache shards the heads axis")
+            rules = (partition_rules
+                     if partition_rules is not None
+                     else partition.transformer_lm_rules("mp"))
+            self.param_specs = partition.match_partition_rules(
+                rules, self.params)
+            self.params = partition.shard_params(
+                self.params, mesh, specs=self.param_specs)
+            if self.adapters is not None:
+                self.adapters = partition.shard_params(
+                    self.adapters, mesh, "lora")
+            self.kv_spec = partition.kv_cache_spec("mp")
+            kv_sharding = NamedSharding(mesh, self.kv_spec)
+            rep_sharding = NamedSharding(
+                mesh, jax.sharding.PartitionSpec())
+
         prefill, step = make_kv_decode(model.n_heads, dtype=kv_dtype)
         S, eos, max_len_ = self.n_slots, self._eos, self.max_len
 
@@ -243,9 +297,32 @@ class DecodeEngine:
             return out, (nxt, active)
 
         # the carry is DONATED: the cache never round-trips host<->device
-        # and XLA may update the slot rows in place
-        self._admit_jit = jax.jit(_admit, donate_argnums=(2,))
-        self._step_jit = jax.jit(_step_all, donate_argnums=(2,))
+        # and XLA may update the slot rows in place. On an mp mesh the
+        # carry's output shardings are PINNED (cache on the heads split,
+        # scalars-per-slot replicated): donation requires the output
+        # buffer to reuse the input's layout, and an XLA-chosen resharding
+        # would silently turn the in-place update into a full copy.
+        if mesh is None:
+            self._admit_jit = jax.jit(_admit, donate_argnums=(2,))
+            self._step_jit = jax.jit(_step_all, donate_argnums=(2,))
+            carry_sh = None
+        else:
+            # ONE carry-layout dict, used for the jit out_shardings AND the
+            # initial placement below — two copies drifting apart (a new
+            # carry key updated in only one) would silently turn the
+            # donated in-place update into a full cache copy
+            carry_sh = {
+                "cache": {"k": kv_sharding, "v": kv_sharding},
+                "pos": rep_sharding, "tok": rep_sharding,
+                "active": rep_sharding, "temp": rep_sharding,
+                "seed": rep_sharding, "limit": rep_sharding,
+            }
+            self._admit_jit = jax.jit(
+                _admit, donate_argnums=(2,),
+                out_shardings=(carry_sh, rep_sharding))
+            self._step_jit = jax.jit(
+                _step_all, donate_argnums=(2,),
+                out_shardings=(carry_sh, (rep_sharding, rep_sharding)))
 
         head = model.d_model // model.n_heads
         z = (model.n_layers, S, self.max_len, model.n_heads, head)
@@ -259,6 +336,11 @@ class DecodeEngine:
             "seed": jnp.zeros((S,), jnp.uint32),
             "limit": jnp.zeros((S,), jnp.int32),
         }
+        if carry_sh is not None:
+            # place the persistent carry on the mesh up front — every later
+            # call donates it back in the same layout
+            self._carry = jax.tree.map(
+                lambda a, s: jax.device_put(a, s), self._carry, carry_sh)
 
         self._cond = threading.Condition()
         self._waiting: deque[_Request] = deque()

@@ -107,7 +107,8 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
         decode_slots=int(sv.get("decode_slots", 0)),
         eos_id=None if eos is None else int(eos),
         engine_fetch_chunk=int(sv.get("engine_fetch_chunk", 2)),
-        sampler_cache_size=int(sv.get("sampler_cache_size", 4)))
+        sampler_cache_size=int(sv.get("sampler_cache_size", 4)),
+        engine_mp=int(sv.get("engine_mp", 0)))
 
 
 def _bucket(n: int, pow2_cap: int = 1024) -> int:
@@ -193,7 +194,8 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                  adapters: Optional[Pytree] = None,
                  compute_dtype: Optional[str] = None,
                  decode_slots: int = 0, eos_id: Optional[int] = None,
-                 sampler_cache_size: int = 4, engine_fetch_chunk: int = 2):
+                 sampler_cache_size: int = 4, engine_fetch_chunk: int = 2,
+                 engine_mp: int = 0):
         self.model = model
         self.params = params
         self.detokenize = detokenize
@@ -288,14 +290,21 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 # continuous batching (serving/engine.py): S slots share
                 # one persistent donated KV cache; requests stream through
                 # the engine thread instead of serializing on this
-                # predictor's jit calls
+                # predictor's jit calls. engine_mp > 1 runs the engine
+                # tensor-parallel over an {"mp": N} device mesh (weights +
+                # KV cache sharded via the parallel/partition.py registry).
                 from .engine import DecodeEngine
 
+                mesh = None
+                if int(engine_mp) > 1:
+                    from ..parallel.mesh import make_mesh
+
+                    mesh = make_mesh({"mp": int(engine_mp)})
                 self.engine = DecodeEngine(
                     model, self.params, adapters=self.adapters,
                     n_slots=int(decode_slots), max_len=max_len,
                     eos_id=eos_id, dtype=kv_dtype,
-                    fetch_chunk=engine_fetch_chunk).start()
+                    fetch_chunk=engine_fetch_chunk, mesh=mesh).start()
             return
 
         # n_steps is a Python int at trace time (scan length must be
