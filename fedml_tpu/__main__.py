@@ -424,6 +424,17 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             tr = rate("serving_tokens_total")
             if tr is not None:
                 seg += f"  tok/s {tr:.1f}"
+            # paged-KV plane (serving/engine.py page_size > 0): physical
+            # page occupancy + prefix-cache hit rate
+            pt = g.get("serving_kv_pages_budget")
+            if pt:
+                free = g.get("serving_kv_pages_free", 0)
+                seg += (f"  pages {int(pt - free)}/{int(pt)} "
+                        f"({(pt - free) / pt * 100:.0f}%)")
+            hits = int(c.get("serving_prefix_hits_total", 0))
+            miss = int(c.get("serving_prefix_misses_total", 0))
+            if hits + miss:
+                seg += f"  prefix {hits / (hits + miss) * 100:.0f}%"
             for label, key in (("ttft", "serving_ttft"),
                                ("tbt", "serving_tbt")):
                 hh = h.get(key)
@@ -716,6 +727,65 @@ def cmd_diagnosis(args) -> int:
         return {"requests": 8, "max_slots_active": max_active[0],
                 "programs": counts}
 
+    def serving_paged_smoke():
+        # the paged-KV serving plane end-to-end (ISSUE 7): a tiny LM on
+        # the PAGED engine under a page budget well below the contiguous
+        # equivalent, 8 concurrent requests sharing a common prompt
+        # prefix — allocation must serve all of them, the prefix cache
+        # must hit (the shared head is resident after the first
+        # admission), retirement must reclaim pages (free + resident
+        # prefix pages == the full budget afterwards), and the compiled-
+        # program set must stay bounded (one paged step + pow2 chunk
+        # buckets).
+        import jax as _jax
+        import jax.numpy as _jnp
+        import numpy as _np
+
+        from .llm.transformer import TransformerLM
+        from .serving.engine import DecodeEngine
+        from .utils import metrics as mx
+
+        model = TransformerLM(vocab_size=64, d_model=32, n_layers=1,
+                              n_heads=2, d_ff=64, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        rs = _np.random.RandomState(0)
+        head = rs.randint(1, 64, 8).tolist()    # shared 2-page prefix
+        # 12-token prompts (4-token suffixes): every chunk is exactly one
+        # bucket, so the probe compiles ONE chunk program + one step —
+        # this probe runs twice inside tier-1, keep it lean
+        prompts = [head + rs.randint(1, 64, 4).tolist() for _ in range(6)]
+        # 19 usable pages vs the contiguous equivalent of
+        # slots * max_len / page_size = 3 * 32 / 4 = 24
+        eng = DecodeEngine(model, params, n_slots=3, max_len=32,
+                           page_size=4, n_pages=20, prefill_chunk=4).start()
+        try:
+            tickets = [eng.submit(p, 4) for p in prompts]
+            outs = [t.result(timeout=60) for t in tickets]
+            counts = eng.program_counts()
+            snap = mx.snapshot()
+            free = snap["gauges"]["serving.kv_pages_free"]
+            resident = len(eng._prefix)
+        finally:
+            eng.stop()
+        if len(outs) != 6 or any(len(o) != 4 for o in outs):
+            raise ValueError(f"responses malformed: {[len(o) for o in outs]}")
+        hits = snap["counters"].get("serving.prefix_hits", 0)
+        if hits < 1:
+            raise ValueError("shared prompt prefix never hit the "
+                             f"prefix cache (hits {hits})")
+        if free + resident != 19:
+            raise ValueError(
+                f"retirement did not reclaim pages: free {free} + "
+                f"resident prefix {resident} != budget 19")
+        if counts["step"] not in (None, 1):
+            raise ValueError(f"paged step retraced: {counts}")
+        if counts["admit"] is not None and counts["admit"] > 1:
+            raise ValueError(f"chunk programs unbounded: {counts}")
+        return {"requests": 6, "prefix_hits": int(hits),
+                "pages_free": int(free), "prefix_resident": resident,
+                "programs": counts}
+
     def partition_rules_smoke():
         # the partitioning plane end-to-end (ISSUE 6): build the registry,
         # resolve the flagship TransformerLM in its serving shape (scan
@@ -791,19 +861,28 @@ def cmd_diagnosis(args) -> int:
         return {"resolved_params": len(_jax.tree_util.tree_leaves(specs)),
                 **mesh_child, "mode": "forced-2-device subprocess"}
 
-    check("jax", jax_devices)
-    check("wire_codec", wire)
-    check("loopback_transport", loopback)
-    check("grpc_transport", grpc)
-    check("native_lib", native)
-    check("metrics_endpoint", metrics_endpoint)
-    check("chaos_smoke", chaos_smoke)
-    check("serving_engine_smoke", serving_engine_smoke)
-    check("partition_rules_smoke", partition_rules_smoke)
-    required_ok = all(checks[k]["ok"] for k in
-                      ("jax", "wire_codec", "loopback_transport",
-                       "chaos_smoke", "serving_engine_smoke",
-                       "partition_rules_smoke"))
+    probes = {"jax": jax_devices, "wire_codec": wire,
+              "loopback_transport": loopback, "grpc_transport": grpc,
+              "native_lib": native, "metrics_endpoint": metrics_endpoint,
+              "chaos_smoke": chaos_smoke,
+              "serving_engine_smoke": serving_engine_smoke,
+              "serving_paged_smoke": serving_paged_smoke,
+              "partition_rules_smoke": partition_rules_smoke}
+    required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
+                "serving_engine_smoke", "serving_paged_smoke",
+                "partition_rules_smoke")
+    # --only: run a subset by name — a failing fleet probe can be re-run
+    # in seconds instead of paying the full battery every iteration
+    selected = getattr(args, "only", None) or list(probes)
+    unknown = sorted(set(selected) - set(probes))
+    if unknown:
+        print(f"unknown probe(s) {unknown}; available: {sorted(probes)}",
+              file=sys.stderr)
+        return 2
+    for name in probes:
+        if name in selected:
+            check(name, probes[name])
+    required_ok = all(checks[k]["ok"] for k in required if k in checks)
     print(json.dumps({"ok": required_ok, "checks": checks}, indent=2))
     return 0 if required_ok else 1
 
@@ -836,8 +915,12 @@ def main(argv=None) -> int:
     gp.add_argument("--run", default=None, help="run-name prefix filter")
     gp.add_argument("--tail", type=int, default=50)
     gp.add_argument("--list", action="store_true", help="list runs only")
-    sub.add_parser("diagnosis",
-                   help="transport/device connectivity checks")
+    dp = sub.add_parser("diagnosis",
+                        help="transport/device connectivity checks")
+    dp.add_argument("--only", nargs="+", default=None, metavar="PROBE",
+                    help="run only the named probe(s) — e.g. "
+                         "`diagnosis --only chaos_smoke` re-checks one "
+                         "failing probe without the full battery")
     rp = sub.add_parser("report",
                         help="summarize a tracked run's telemetry "
                              "(spans, counters, trace pointer)")

@@ -334,7 +334,9 @@ class Config:
         # too — a misspelled decode_slots must not pass silently.
         _serve_knobs = {"decode_slots", "engine_max_len",
                         "engine_fetch_chunk", "engine_eos_id",
-                        "sampler_cache_size", "kv_cache", "engine_mp"}
+                        "sampler_cache_size", "kv_cache", "engine_mp",
+                        "kv_page_size", "kv_n_pages", "prefill_chunk",
+                        "prefix_cache"}
         unknown = set(self.serve_args.extra) - _serve_knobs
         if unknown:
             raise ValueError(
@@ -344,9 +346,15 @@ class Config:
         if kvc is not None and not isinstance(kvc, bool):
             raise ValueError(
                 f"serve_args.kv_cache must be a boolean; got {kvc!r}")
+        pfx = self.serve_args.extra.get("prefix_cache")
+        if pfx is not None and not isinstance(pfx, bool):
+            raise ValueError(
+                f"serve_args.prefix_cache must be a boolean; got {pfx!r}")
         for knob, lo in (("decode_slots", 0), ("engine_max_len", 1),
                          ("engine_fetch_chunk", 1), ("engine_eos_id", 0),
-                         ("sampler_cache_size", 1), ("engine_mp", 1)):
+                         ("sampler_cache_size", 1), ("engine_mp", 1),
+                         ("kv_page_size", 1), ("kv_n_pages", 2),
+                         ("prefill_chunk", 0)):
             val = self.serve_args.extra.get(knob)
             if val is None:
                 continue
@@ -371,6 +379,23 @@ class Config:
                 "serve_args.engine_mp > 1 requires decode_slots > 0 — "
                 "tensor-parallel serving runs inside the decode engine; "
                 "without slots the knob would be silently ignored")
+        # paged-cache knobs (serving/engine.py page_size > 0) are gated
+        # the same way: each only takes effect inside the paged engine,
+        # so a config naming one without its prerequisite would silently
+        # serve contiguous/per-request — refuse at load instead
+        if self.serve_args.extra.get("kv_page_size") \
+                and not self.serve_args.extra.get("decode_slots"):
+            raise ValueError(
+                "serve_args.kv_page_size requires decode_slots > 0 — the "
+                "paged KV cache lives inside the decode engine; without "
+                "slots the knob would be silently ignored")
+        for knob in ("kv_n_pages", "prefill_chunk", "prefix_cache"):
+            if self.serve_args.extra.get(knob) is not None \
+                    and not self.serve_args.extra.get("kv_page_size"):
+                raise ValueError(
+                    f"serve_args.{knob} requires kv_page_size > 0 (the "
+                    "paged KV cache) — without paging the knob would be "
+                    "silently ignored")
         # partitioning-plane knobs (parallel/partition.py): the rule-table
         # name must exist in the registry and the unmatched policy must be
         # a known one — a typo'd table fails at load, not as an

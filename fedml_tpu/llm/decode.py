@@ -231,6 +231,151 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
     return prefill, step
 
 
+def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
+                         dtype=jnp.float32, eps: float = 1e-6):
+    """Paged variant of make_kv_decode for the block-allocated engine
+    cache (serving/engine.py): K/V live in a POOL of fixed-size pages
+    `[L, n_pages, page_size, H, Dh]` instead of one contiguous
+    `[L, S, max_len, H, Dh]` buffer, and each slot's logical sequence is
+    described by an int32 page-table row mapping virtual position
+    `t -> (row[t // page_size], t % page_size)`. Pages are what make the
+    engine's HBM proportional to LIVE tokens (and lets identical prompt
+    prefixes share physical pages) rather than `slots x max_len`.
+
+    Returns (chunk, step):
+
+    chunk(params, adapters, cache, pages_row, tokens, t0, length)
+        -> (cache, logits)     # ONE slot: process `length` prompt tokens
+                               # (tokens [1, C] right-padded; length traced)
+                               # at global positions t0..t0+length-1,
+                               # writing their roped K / raw V into the
+                               # slot's pages and attending against the
+                               # gathered history + the chunk itself;
+                               # logits [1, V] at position t0+length-1.
+                               # Admission calls this repeatedly —
+                               # chunked prefill — so a long prompt never
+                               # occupies the device for more than one
+                               # chunk between decode iterations.
+    step(params, adapters, cache, pages, pos, token, active)
+        -> (cache, logits)     # ALL slots one token: pages [S, max_pages],
+                               # pos/token [S]. `active` REDIRECTS inactive
+                               # slots' garbage K/V write to the reserved
+                               # null page 0 — unlike the contiguous
+                               # layout, an inactive slot's stale page-
+                               # table entry may point at a page that was
+                               # freed and re-allocated to ANOTHER slot,
+                               # so "write lands on a frozen position" is
+                               # no longer a safe place to park it.
+
+    Page 0 is the null/trash page by contract: never allocated to a
+    request, it absorbs padded-position and inactive-slot writes; reads
+    of it only ever surface at virtual positions beyond a slot's `pos`,
+    which the live mask discards. Attention gathers each slot's pages
+    into a virtually-contiguous [max_pages * page_size] sequence, so the
+    math (and, pinned in tests, the greedy tokens) matches the contiguous
+    cache — the gather is the XLA-level cost of paging; the win is that
+    the PERSISTENT pool holds only `n_pages * page_size` rows."""
+    ps = int(page_size)
+
+    def norm(x, scale):
+        return rms_norm(x, scale, eps)
+
+    def dq(leaf):
+        return dequant_leaf(leaf, dtype)
+
+    def merged(bl, ad_l, name, rank_scale):
+        return merged_kernel(bl, ad_l, name, rank_scale, dtype)
+
+    def qkv(bl, ad_l, rank_scale, h, n_hd):
+        return project_qkv(bl, ad_l, rank_scale, h, n_hd, dtype)
+
+    def mlp(bl, ad_l, rank_scale, x):
+        return swiglu_mlp(bl, ad_l, rank_scale, x, dtype, eps)
+
+    def head(params, top_ads, rank_scale, x):
+        return lm_head_logits(params, top_ads, rank_scale, x, dtype, eps)
+
+    def chunk(params, adapters, cache, pages_row, tokens, t0, length):
+        blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
+        emb = dq(params["embed"]["embedding"])
+        x = emb[tokens]                                   # [1, C, D]
+        c = tokens.shape[1]
+        j = jnp.arange(c)
+        posr = jnp.asarray(t0, jnp.int32) + j             # [C] global pos
+        length = jnp.asarray(length, jnp.int32)
+        # padded tail positions (j >= length) write to the null page
+        wpage = jnp.where(j < length, pages_row[posr // ps], 0)
+        woff = posr % ps
+        n_virt = pages_row.shape[0] * ps
+
+        def body(x, layer):
+            bl, ad_l, ck, cv = layer                      # ck/cv [P,ps,H,Dh]
+            h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
+            q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
+            q = _rope_rows(q, posr[None, :])
+            k = _rope_rows(k, posr[None, :])
+            ck = ck.at[wpage, woff].set(k[0])
+            cv = cv.at[wpage, woff].set(v[0])
+            # gather AFTER the write so the chunk attends to itself;
+            # page-table order makes the gathered view contiguous virtual
+            # positions 0..n_virt-1
+            kk = ck[pages_row].reshape((n_virt,) + ck.shape[2:])
+            vv = cv[pages_row].reshape((n_virt,) + cv.shape[2:])
+            scale = q.shape[-1] ** -0.5
+            s = jnp.einsum("bqhd,khd->bhqk", q, kk) * scale
+            live = jnp.arange(n_virt)[None, :] <= posr[:, None]  # [C, T]
+            s = jnp.where(live[None, None, :, :], s, _NEG)
+            o = jnp.einsum("bhqk,khd->bqhd", jax.nn.softmax(s, -1), vv)
+            x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
+                bl, ad_l, "wo", rank_scale)
+            x = mlp(bl, ad_l, rank_scale, x)
+            return x, (ck, cv)
+
+        x, (ck, cv) = jax.lax.scan(
+            body, x, (params["blocks"], blk_ads, cache["k"], cache["v"]))
+        last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
+                                            keepdims=False)
+        logits = head(params, top_ads, rank_scale, last[None, None])
+        return {"k": ck, "v": cv}, logits[:, 0]
+
+    def step(params, adapters, cache, pages, pos, token, active):
+        blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
+        emb = dq(params["embed"]["embedding"])
+        x = emb[token][:, None, :]                        # [S, 1, D]
+        s_ = token.shape[0]
+        pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (s_,))
+        wpage = jnp.where(active, pages[jnp.arange(s_), pos // ps], 0)
+        woff = pos % ps
+        n_virt = pages.shape[1] * ps
+
+        def body(x, layer):
+            bl, ad_l, ck, cv = layer
+            h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
+            q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
+            q = _rope_rows(q, pos[:, None])
+            k = _rope_rows(k, pos[:, None])
+            ck = ck.at[wpage, woff].set(k[:, 0])
+            cv = cv.at[wpage, woff].set(v[:, 0])
+            kk = ck[pages].reshape((s_, n_virt) + ck.shape[2:])
+            vv = cv[pages].reshape((s_, n_virt) + cv.shape[2:])
+            scale = q.shape[-1] ** -0.5
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+            live = jnp.arange(n_virt)[None] <= pos[:, None]      # [S, T]
+            s = jnp.where(live[:, None, None, :], s, _NEG)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vv)
+            x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
+                bl, ad_l, "wo", rank_scale)
+            x = mlp(bl, ad_l, rank_scale, x)
+            return x, (ck, cv)
+
+        x, (ck, cv) = jax.lax.scan(
+            body, x, (params["blocks"], blk_ads, cache["k"], cache["v"]))
+        logits = head(params, top_ads, rank_scale, x)
+        return {"k": ck, "v": cv}, logits[:, 0]
+
+    return chunk, step
+
+
 def make_generate(n_heads: int, alpha: float = 16.0,
                   dtype=jnp.float32, eps: float = 1e-6,
                   sample: bool = False, top_k: int = 0,

@@ -262,6 +262,18 @@ def kv_cache_spec(axis: str = "mp"):
     return P(None, None, None, axis, None)
 
 
+def paged_kv_cache_spec(axis: str = "mp"):
+    """PartitionSpec for the PAGED engine KV pool
+    `[L, n_pages, page_size, H, Dh]` (serving/engine.py page_size > 0):
+    heads sharded over `axis`, page axes replicated — the same Megatron
+    continuation as `kv_cache_spec`, with the slot/time axes replaced by
+    the page pool. The int32 page table `[S, max_pages]` rides the carry
+    replicated (it is indexed identically on every chip)."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, None, axis, None)
+
+
 TABLES = {
     "transformer_lm": transformer_lm_rules,
     "mlp_cnn": mlp_cnn_rules,

@@ -42,9 +42,50 @@ temperature/seed traced) + one admit program per prompt bucket
 (log2(max_len) of them at most). `program_counts()` exposes the live jit
 cache sizes; tests pin them.
 
+PAGED MODE (`page_size > 0`, ISSUE 7) rebuilds the KV storage as block
+allocation — the production serving memory + latency plane:
+
+- The cache becomes a POOL `[L, kv_n_pages, page_size, H, Dh]` plus an
+  int32 `[S, max_pages]` page table INSIDE the donated carry (the jitted
+  step gathers each slot's pages into a virtually-contiguous sequence;
+  llm/decode.py make_paged_kv_decode). Persistent HBM is
+  `kv_n_pages x page_size` token rows — sized to LIVE tokens — instead
+  of `S x max_len` whether slots use it or not; page 0 is the reserved
+  null page that absorbs inactive/padded writes.
+- Admission allocates a request's pages (ceil((prompt+max_new)/page_size),
+  reserved up front so a mid-decode slot can never hit page exhaustion)
+  from a host free list; retirement returns them. The free list + prefix
+  map are host state — the page TABLE is the device-side structure the
+  kernels consume; allocation is a host decision because prefix sharing
+  keys on token content the device never sees.
+- CHUNKED PREFILL: admission writes the prompt in `prefill_chunk`-sized
+  pieces, ONE chunk per engine iteration, round-robin across in-flight
+  admissions — decode slots advance between chunks, so a long prompt no
+  longer stalls all S slots for its full prefill, and a short prompt
+  admitted alongside a long one reaches its first token in time
+  proportional to its OWN length.
+- PREFIX CACHE: full pages of a prompt are registered in a content-hash
+  chain map (hash over token IDS per page, chained — resident pages are
+  ref-counted; refs==0 entries stay resident and evict LRU, leaf-first,
+  only under allocation pressure). A request whose prompt prefix is
+  already resident starts its chunked prefill AFTER the hit (capped at
+  prompt_len - 1 so the first-token logits are always computed), so
+  identical system prompts — the dominant traffic shape — stop
+  recomputing K/V and their TTFT goes ~flat in prompt length.
+
+Paged greedy output is TOKEN-IDENTICAL to the contiguous engine and the
+per-request path (pinned in tests/test_paged_engine.py), and the program
+set stays bounded: one paged step program + one chunk program per chunk
+bucket (log2(prefill_chunk) of them at most).
+
 Capacity contract per slot: `prompt_len + max_new_tokens <= max_len`
 (no step bucketing — the engine emits exactly the tokens asked for, so
-unlike the per-request path max_new_tokens is not rounded up).
+unlike the per-request path max_new_tokens is not rounded up). Paged
+mode ADDS the page-budget term: ceil((prompt + max_new) / page_size)
+must fit the usable pool (kv_n_pages - 1 — page 0 is reserved);
+`admissible()` is the one capacity oracle the predictor's routing and
+degrade refusal consult, and the submit error message states the page
+math.
 
 Equivalence contract: for identical prompts, greedy engine output is
 token-identical to the per-request path — the slot axis is data-parallel
@@ -58,6 +99,7 @@ top`.
 """
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 import time
@@ -76,12 +118,58 @@ log = logging.getLogger(__name__)
 Pytree = Any
 
 
+def _page_key(parent: bytes, tokens) -> bytes:
+    """Chain hash for one prefix page: keyed on the page's TOKEN IDS (an
+    int32 byte view — [12, 3] and [1, 23] must not collide the way naive
+    string concatenation would) chained through the parent page's key, so
+    a key identifies the FULL token prefix up to and including this page."""
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class _PrefixEntry:
+    """One resident prefix page: refs counts live users (slots decoding
+    over it); kids counts resident chain extensions. Evictable only at
+    refs == 0 AND kids == 0 (evicting a mid-chain page would strand its
+    extensions — resident but unreachable by the incremental hash walk)."""
+
+    __slots__ = ("page", "parent", "refs", "kids", "tick")
+
+    def __init__(self, page: int, parent: Optional[bytes], tick: int):
+        self.page = page
+        self.parent = parent
+        self.refs = 1
+        self.kids = 0
+        self.tick = tick
+
+
+class _Admission:
+    """One in-flight chunked admission: `row` is the slot's full page-table
+    row (prefix-hit pages + freshly allocated ones), `t0` the next prompt
+    position to prefill (starts at the page-aligned hit length), `keys`
+    the chain hashes for every FULL prompt page (computed once at lookup,
+    reused at registration)."""
+
+    __slots__ = ("req", "slot", "row", "t0", "keys", "hit_pages", "total")
+
+    def __init__(self, req, slot, row, t0, keys, hit_pages, total):
+        self.req = req
+        self.slot = slot
+        self.row = row
+        self.t0 = t0
+        self.keys = keys
+        self.hit_pages = hit_pages
+        self.total = total
+
+
 class Ticket:
     """Per-request handle: the HTTP handler blocks on `result()` while the
     engine thread decodes — requests no longer serialize through one
     global jit call; concurrency is bounded by slots, not threads."""
 
-    __slots__ = ("_done", "_tokens", "_error", "t_submit", "t_first")
+    __slots__ = ("_done", "_tokens", "_error", "t_submit", "t_first",
+                 "t_done")
 
     def __init__(self):
         self._done = threading.Event()
@@ -89,6 +177,7 @@ class Ticket:
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
 
     def result(self, timeout: Optional[float] = None) -> list[int]:
         """Block until the request retires; returns the generated tokens
@@ -117,14 +206,20 @@ class _Request:
 
 class _SlotState:
     """Host-side view of an occupied slot (the device mask is the source
-    of truth for retirement; this mirrors it frame-by-frame)."""
+    of truth for retirement; this mirrors it frame-by-frame). Paged mode
+    additionally tracks what retirement must release: `entries` (prefix
+    pages this slot holds a ref on) and `private` (pages owned outright —
+    the prompt tail, the decode budget, and any page whose registration
+    lost a race to a concurrent identical prompt)."""
 
-    __slots__ = ("req", "out", "t_first")
+    __slots__ = ("req", "out", "t_first", "entries", "private")
 
     def __init__(self, req: _Request):
         self.req = req
         self.out: list[int] = []
         self.t_first: Optional[float] = None
+        self.entries: list[_PrefixEntry] = []
+        self.private: list[int] = []
 
 
 class DecodeEngine:
@@ -145,16 +240,28 @@ class DecodeEngine:
     parallel/partition.py rule registry (`partition_rules` overrides the
     default `transformer_lm` table) — the scale-out path for models whose
     KV cache + weights exceed one chip's HBM. Greedy output is
-    token-identical across mp sizes (pinned at mp=1 vs mp=2 in tests)."""
+    token-identical across mp sizes (pinned at mp=1 vs mp=2 in tests).
+
+    `page_size > 0` selects the PAGED KV cache (module docstring):
+    `n_pages` sizes the pool (default = contiguous capacity + the null
+    page; pass less to trade peak concurrency for HBM), `prefill_chunk`
+    bounds how many prompt tokens one admission program processes
+    (0 = whole prompt in one chunk), `prefix_cache` toggles content-hash
+    prefix page reuse. Composes with `mesh` (pages replicate; the pool
+    shards its heads axis). Paged greedy output is token-identical to
+    contiguous (pinned in tests/test_paged_engine.py)."""
 
     def __init__(self, model, params: Pytree,
                  adapters: Optional[Pytree] = None, *,
                  n_slots: int = 4, max_len: int = 256,
                  eos_id: Optional[int] = None,
                  dtype=None, fetch_chunk: int = 2,
-                 mesh=None, partition_rules=None):
+                 mesh=None, partition_rules=None,
+                 page_size: int = 0, n_pages: Optional[int] = None,
+                 prefill_chunk: int = 0, prefix_cache: bool = True):
         from ..llm.decode import (
-            make_kv_decode, stack_adapter_blocks, stack_blocks,
+            make_kv_decode, make_paged_kv_decode, stack_adapter_blocks,
+            stack_blocks,
         )
 
         if n_slots < 1:
@@ -163,6 +270,42 @@ class DecodeEngine:
         self.max_len = int(max_len)
         self.n_slots = int(n_slots)
         self.fetch_chunk = max(1, int(fetch_chunk))
+        # ---------------------------------------------------- paged layout
+        # page_size > 0 selects the block/paged KV cache; 0 keeps the
+        # contiguous [L, S, max_len, H, Dh] layout (still preferable when
+        # every request genuinely runs to ~max_len: no gather, no page
+        # bookkeeping). The paged knobs are refused in contiguous mode so
+        # a config asking for them is never silently ignored.
+        self._paged = int(page_size or 0) > 0
+        if self._paged:
+            self._page_size = int(page_size)
+            self._max_pages = -(-self.max_len // self._page_size)
+            # default pool = contiguous capacity + the reserved null page;
+            # the memory win comes from passing a SMALLER kv_n_pages
+            self._n_pages = (int(n_pages) if n_pages
+                             else self.n_slots * self._max_pages + 1)
+            self._usable = self._n_pages - 1   # page 0 is the null page
+            if self._n_pages < 2:
+                raise ValueError(
+                    f"kv_n_pages must be >= 2 (page 0 is the reserved "
+                    f"null page); got {self._n_pages}")
+            if int(prefill_chunk) < 0:
+                raise ValueError(
+                    f"prefill_chunk must be >= 0 (0 = whole-prompt "
+                    f"chunks); got {prefill_chunk}")
+            self._prefill_chunk = int(prefill_chunk)
+            self._prefix_on = bool(prefix_cache)
+            self._free_pages: list[int] = list(range(1, self._n_pages))
+            self._prefix: dict[bytes, _PrefixEntry] = {}
+            self._ticks = 0
+            _mx.set_gauge("serving.kv_pages_budget", self._usable)
+            _mx.set_gauge("serving.kv_pages_free", len(self._free_pages))
+        elif n_pages or prefill_chunk:
+            raise ValueError(
+                "kv_n_pages/prefill_chunk configure the PAGED cache — "
+                "set page_size > 0 (they would be silently ignored in "
+                "contiguous mode)")
+        self._admissions: deque[_Admission] = deque()
         # -1 never matches a token id, so eos retirement is inert
         self._eos = -1 if eos_id is None else int(eos_id)
         self.adapters = stack_adapter_blocks(adapters, model.n_layers)
@@ -215,12 +358,20 @@ class DecodeEngine:
             if self.adapters is not None:
                 self.adapters = partition.shard_params(
                     self.adapters, mesh, "lora")
-            self.kv_spec = partition.kv_cache_spec("mp")
+            # both layouts are 5-D with heads at axis 3; the paged spec is
+            # its own registry entry so the page axes are named, not
+            # incidentally covered
+            self.kv_spec = (partition.paged_kv_cache_spec("mp")
+                            if self._paged else partition.kv_cache_spec("mp"))
             kv_sharding = NamedSharding(mesh, self.kv_spec)
             rep_sharding = NamedSharding(
                 mesh, jax.sharding.PartitionSpec())
 
-        prefill, step = make_kv_decode(model.n_heads, dtype=kv_dtype)
+        if self._paged:
+            chunk_fn, paged_step = make_paged_kv_decode(
+                model.n_heads, self._page_size, dtype=kv_dtype)
+        else:
+            prefill, step = make_kv_decode(model.n_heads, dtype=kv_dtype)
         S, eos, max_len_ = self.n_slots, self._eos, self.max_len
 
         def pick(logits, temp, key):
@@ -239,44 +390,11 @@ class DecodeEngine:
                         key, l)
             return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
 
-        def _admit(params, adapters, carry, tokens, length, slot, temp,
-                   seed, limit):
-            """Prefill one request into slot `slot` of the donated carry:
-            K/V rows land at the slot index of the persistent cache, the
-            prompt's last-position logits yield the first token, and the
-            slot's pos/tok/active/temp/seed/limit rows are set."""
-            row, logits = prefill(params, adapters, tokens, max_len_,
-                                  length=length)
-            key = jax.random.fold_in(jax.random.key(seed), length)
-            first = pick(logits[0], temp, key)
-            start = (0, slot, 0, 0, 0)
-            cache = {
-                "k": jax.lax.dynamic_update_slice(
-                    carry["cache"]["k"], row["k"], start),
-                "v": jax.lax.dynamic_update_slice(
-                    carry["cache"]["v"], row["v"], start),
-            }
-            # active iff the first token did not end it and there is
-            # budget left (limit = length + max_new - 1: the position
-            # after which no further step token is owed)
-            active = (first != eos) & (length < limit)
-            return {
-                "cache": cache,
-                "pos": carry["pos"].at[slot].set(length),
-                "tok": carry["tok"].at[slot].set(first),
-                "active": carry["active"].at[slot].set(active),
-                "temp": carry["temp"].at[slot].set(temp),
-                "seed": carry["seed"].at[slot].set(seed),
-                "limit": carry["limit"].at[slot].set(limit),
-            }, first
-
-        def _step_all(params, adapters, carry):
-            """Advance every slot one token through ONE program. Inactive
-            slots are inert: pos frozen, tok unchanged, their (garbage)
-            K/V write lands on a frozen position that the next admission's
-            full prefill row overwrites."""
-            cache, logits = step(params, adapters, carry["cache"],
-                                 carry["pos"], carry["tok"])
+        def _decode_tail(carry, cache, logits, extra=None):
+            """Shared post-forward step logic: sample/argmax the next
+            token per slot, advance active positions, retire on budget or
+            eos — ON DEVICE. `extra` carries layout-specific keys (the
+            paged page table) through unchanged."""
             active, temp = carry["active"], carry["temp"]
             keys = jax.vmap(
                 lambda s, p: jax.random.fold_in(jax.random.key(s), p + 1))(
@@ -293,8 +411,93 @@ class DecodeEngine:
                 "seed": carry["seed"],
                 "limit": carry["limit"],
             }
+            if extra:
+                out.update(extra)
             # emitted token per slot + the entry mask saying which are real
             return out, (nxt, active)
+
+        if self._paged:
+            def _admit(params, adapters, carry, tokens, t0, clen, slot,
+                       row, temp, seed, limit, final, plen):
+                """ONE chunk of one request's prefill into the paged
+                carry: the slot's page-table row is (re)written, the
+                chunk's K/V land in its pages, and — on the FINAL chunk —
+                the last-position logits yield the first token and the
+                slot's rows arm. Non-final chunks set the same rows
+                (harmless while active stays False) so one program covers
+                every chunk; everything but the token buffer is traced."""
+                pages = carry["pages"].at[slot].set(row)
+                cache, logits = chunk_fn(params, adapters, carry["cache"],
+                                         row, tokens, t0, clen)
+                key = jax.random.fold_in(jax.random.key(seed), plen)
+                first = pick(logits[0], temp, key)
+                # active iff this was the last chunk, the first token did
+                # not end it, and there is budget left (limit = plen +
+                # max_new - 1, as in contiguous mode)
+                active = final & (first != eos) & (plen < limit)
+                return {
+                    "cache": cache,
+                    "pages": pages,
+                    "pos": carry["pos"].at[slot].set(plen),
+                    "tok": carry["tok"].at[slot].set(first),
+                    "active": carry["active"].at[slot].set(active),
+                    "temp": carry["temp"].at[slot].set(temp),
+                    "seed": carry["seed"].at[slot].set(seed),
+                    "limit": carry["limit"].at[slot].set(limit),
+                }, first
+
+            def _step_all(params, adapters, carry):
+                """Advance every slot one token. The active mask rides
+                INTO the kernel: an inactive slot's stale page-table entry
+                may point at a page re-allocated to another request, so
+                its garbage write is redirected to the null page instead
+                of parking on a frozen position."""
+                cache, logits = paged_step(
+                    params, adapters, carry["cache"], carry["pages"],
+                    carry["pos"], carry["tok"], carry["active"])
+                return _decode_tail(carry, cache, logits,
+                                    extra={"pages": carry["pages"]})
+        else:
+            def _admit(params, adapters, carry, tokens, length, slot, temp,
+                       seed, limit):
+                """Prefill one request into slot `slot` of the donated
+                carry: K/V rows land at the slot index of the persistent
+                cache, the prompt's last-position logits yield the first
+                token, and the slot's pos/tok/active/temp/seed/limit rows
+                are set."""
+                row, logits = prefill(params, adapters, tokens, max_len_,
+                                      length=length)
+                key = jax.random.fold_in(jax.random.key(seed), length)
+                first = pick(logits[0], temp, key)
+                start = (0, slot, 0, 0, 0)
+                cache = {
+                    "k": jax.lax.dynamic_update_slice(
+                        carry["cache"]["k"], row["k"], start),
+                    "v": jax.lax.dynamic_update_slice(
+                        carry["cache"]["v"], row["v"], start),
+                }
+                # active iff the first token did not end it and there is
+                # budget left (limit = length + max_new - 1: the position
+                # after which no further step token is owed)
+                active = (first != eos) & (length < limit)
+                return {
+                    "cache": cache,
+                    "pos": carry["pos"].at[slot].set(length),
+                    "tok": carry["tok"].at[slot].set(first),
+                    "active": carry["active"].at[slot].set(active),
+                    "temp": carry["temp"].at[slot].set(temp),
+                    "seed": carry["seed"].at[slot].set(seed),
+                    "limit": carry["limit"].at[slot].set(limit),
+                }, first
+
+            def _step_all(params, adapters, carry):
+                """Advance every slot one token through ONE program.
+                Inactive slots are inert: pos frozen, tok unchanged, their
+                (garbage) K/V write lands on a frozen position that the
+                next admission's full prefill row overwrites."""
+                cache, logits = step(params, adapters, carry["cache"],
+                                     carry["pos"], carry["tok"])
+                return _decode_tail(carry, cache, logits)
 
         # the carry is DONATED: the cache never round-trips host<->device
         # and XLA may update the slot rows in place. On an mp mesh the
@@ -317,6 +520,8 @@ class DecodeEngine:
                 "active": rep_sharding, "temp": rep_sharding,
                 "seed": rep_sharding, "limit": rep_sharding,
             }
+            if self._paged:
+                carry_sh["pages"] = rep_sharding
             self._admit_jit = jax.jit(
                 _admit, donate_argnums=(2,),
                 out_shardings=(carry_sh, rep_sharding))
@@ -325,7 +530,11 @@ class DecodeEngine:
                 out_shardings=(carry_sh, (rep_sharding, rep_sharding)))
 
         head = model.d_model // model.n_heads
-        z = (model.n_layers, S, self.max_len, model.n_heads, head)
+        if self._paged:
+            z = (model.n_layers, self._n_pages, self._page_size,
+                 model.n_heads, head)
+        else:
+            z = (model.n_layers, S, self.max_len, model.n_heads, head)
         self._carry = {
             "cache": {"k": jnp.zeros(z, kv_dtype),
                       "v": jnp.zeros(z, kv_dtype)},
@@ -336,6 +545,9 @@ class DecodeEngine:
             "seed": jnp.zeros((S,), jnp.uint32),
             "limit": jnp.zeros((S,), jnp.int32),
         }
+        if self._paged:
+            self._carry["pages"] = jnp.zeros((S, self._max_pages),
+                                             jnp.int32)
         if carry_sh is not None:
             # place the persistent carry on the mesh up front — every later
             # call donates it back in the same layout
@@ -379,11 +591,8 @@ class DecodeEngine:
         if max_new < 1:
             raise InvalidRequest(
                 f"max_new_tokens must be >= 1; got {max_new}")
-        if len(tokens) + max_new > self.max_len:
-            raise InvalidRequest(
-                f"prompt {len(tokens)} + max_new_tokens {max_new} exceeds "
-                f"max_len {self.max_len} (engine slot capacity contract: "
-                "prompt + max_new_tokens <= max_len)")
+        if not self.admissible(len(tokens), max_new):
+            raise InvalidRequest(self.capacity_error(len(tokens), max_new))
         if seed is None:
             import random as _random
 
@@ -406,10 +615,48 @@ class DecodeEngine:
         _mx.inc("serving.engine.requests")
         return req.ticket
 
+    # -------------------------------------------------------------- capacity
+    def admissible(self, prompt_len: int, max_new: int) -> bool:
+        """THE engine capacity oracle: True iff a (prompt_len, max_new)
+        request can ever be admitted. Contiguous: prompt + max_new <=
+        max_len. Paged: additionally ceil((prompt + max_new) / page_size)
+        <= the usable page budget. The predictor's routing consults this
+        (not static max_len math) so a request the page budget refuses
+        falls back to the per-request path instead of 400ing, and one
+        paging admits is never degraded into a per-request 400."""
+        prompt_len, max_new = int(prompt_len), int(max_new)
+        if prompt_len + max_new > self.max_len:
+            return False
+        if self._paged:
+            need = -(-(prompt_len + max_new) // self._page_size)
+            return need <= self._usable
+        return True
+
+    def capacity_error(self, prompt_len: int, max_new: int) -> str:
+        """The message submit() raises for an inadmissible request —
+        states the page math in paged mode so a 400 is actionable."""
+        if not self._paged:
+            return (f"prompt {prompt_len} + max_new_tokens {max_new} "
+                    f"exceeds max_len {self.max_len} (engine slot capacity "
+                    "contract: prompt + max_new_tokens <= max_len)")
+        tot = prompt_len + max_new
+        need = -(-tot // self._page_size)
+        return (f"prompt {prompt_len} + max_new_tokens {max_new} = {tot} "
+                f"tokens needs ceil({tot}/{self._page_size}) = {need} KV "
+                f"pages, but the engine budget is {self._usable} usable "
+                f"pages (kv_n_pages {self._n_pages} minus the reserved "
+                f"null page) with per-request cap max_len {self.max_len} "
+                "(paged capacity contract: prompt + max_new_tokens <= "
+                "max_len AND ceil((prompt + max_new_tokens) / "
+                "kv_page_size) <= kv_n_pages - 1)")
+
     # ------------------------------------------------------- introspection
     def program_counts(self) -> dict:
         """Live compiled-program counts: {"step": 1, "admit": <=
-        log2(max_len)} in steady state — the retrace guard tests pin."""
+        log2(max_len)} in steady state — the retrace guard tests pin.
+        In paged mode "admit" is the chunk program (<= log2(prefill_chunk)
+        + 1 buckets: chunks are prefill_chunk-sized except a final
+        pow2-bucketed remainder)."""
         out = {}
         for name, fn in (("step", self._step_jit),
                          ("admit", self._admit_jit)):
@@ -433,8 +680,16 @@ class DecodeEngine:
                     if idle:
                         self._cond.wait(0.2)
                         continue
-                self._admit_ready(pending)
-                if any(s is not None for s in self._slots):
+                if self._paged:
+                    self._advance_admissions(pending)
+                else:
+                    self._admit_ready(pending)
+                # step when any occupied slot is past admission — a slot
+                # mid-chunked-prefill is inert on device, and a step over
+                # ONLY such slots would be a wasted dispatch
+                admitting = {a.slot for a in self._admissions}
+                if any(s is not None and i not in admitting
+                       for i, s in enumerate(self._slots)):
                     self._carry, (toks, mask) = self._step_jit(
                         self.params, self.adapters, self._carry)
                     pending.append(("step", toks, mask))
@@ -489,6 +744,191 @@ class DecodeEngine:
             pending.append(("admit", slot, first))
             _mx.inc("serving.engine.admissions")
 
+    # ----------------------------------------------- paged admission plane
+    # All of the page machinery below runs on the ENGINE THREAD only
+    # (_advance_admissions from the loop, _release_slot_pages via _drain's
+    # _deliver) — the free list and prefix map need no lock; _cond still
+    # guards the _waiting/_free/_slots handoff with submit()/stop().
+
+    def _next_tick(self) -> int:
+        self._ticks += 1
+        return self._ticks
+
+    def _prefix_lookup(self, toks: list[int]):
+        """(chain keys for every FULL prompt page, resident hit entries).
+        The hit walk is capped at (prompt_len - 1) // page_size pages so
+        at least the prompt's last token is always prefilled — the
+        first-token logits must be computed, not remembered."""
+        ps = self._page_size
+        keys: list[bytes] = []
+        key = b"\x00"
+        for i in range(len(toks) // ps):
+            key = _page_key(key, toks[i * ps:(i + 1) * ps])
+            keys.append(key)
+        hits: list[_PrefixEntry] = []
+        if self._prefix_on:
+            for i in range((len(toks) - 1) // ps):
+                e = self._prefix.get(keys[i])
+                if e is None:
+                    break
+                hits.append(e)
+        return keys, hits
+
+    def _alloc(self, n: int) -> Optional[list[int]]:
+        """Pop `n` pages from the free list, evicting LRU leaf prefix
+        entries (refs == 0, kids == 0) under pressure. None = the pool is
+        pinned by in-flight requests right now — the caller re-queues and
+        retries after a retirement frees pages."""
+        while len(self._free_pages) < n:
+            victim, vkey = None, None
+            for k, e in self._prefix.items():
+                if e.refs == 0 and e.kids == 0 and (
+                        victim is None or e.tick < victim.tick):
+                    victim, vkey = e, k
+            if victim is None:
+                return None
+            del self._prefix[vkey]
+            if victim.parent is not None and victim.parent in self._prefix:
+                self._prefix[victim.parent].kids -= 1
+            self._free_pages.append(victim.page)
+            _mx.inc("serving.prefix_evictions")
+        pages = [self._free_pages.pop() for _ in range(n)]
+        _mx.set_gauge("serving.kv_pages_free", len(self._free_pages))
+        return pages
+
+    def _release_slot_pages(self, st: _SlotState) -> None:
+        """Retirement's page bookkeeping: drop this slot's refs on shared
+        prefix pages (they STAY resident — evictable, reusable) and return
+        its private pages to the free list."""
+        for e in st.entries:
+            e.refs -= 1
+        self._free_pages.extend(st.private)
+        st.entries, st.private = [], []
+        _mx.set_gauge("serving.kv_pages_free", len(self._free_pages))
+
+    def _start_admissions(self) -> None:
+        """Claim (slot, pages) for waiting requests, FIFO. A request whose
+        pages are currently pinned goes back to the queue HEAD — later
+        requests do not overtake it (starvation beats reordering), and
+        liveness holds because submit() already proved the request fits
+        the total budget: whatever is pinned now retires eventually."""
+        while True:
+            with self._cond:
+                if not (self._free and self._waiting):
+                    return
+                req = self._waiting.popleft()
+                slot = self._free.pop()
+                # claim in the SAME critical section as the pop (stop()
+                # racing an admission must find the request somewhere)
+                self._slots[slot] = _SlotState(req)
+                _mx.set_gauge("serving.engine.queue", len(self._waiting))
+            ps = self._page_size
+            # with the prefix cache off there is nothing to look up OR
+            # register — skip the per-page hashing entirely, and leave
+            # the hit/miss counters untouched (a disabled cache reporting
+            # a 0% hit rate on `top` reads as a cache problem, not a knob)
+            keys, hits = (self._prefix_lookup(req.tokens)
+                          if self._prefix_on else ([], []))
+            total = -(-(len(req.tokens) + req.max_new) // ps)
+            # hold the hit refs BEFORE allocating: _alloc evicts refs==0
+            # entries under pressure, and evicting the very pages this
+            # admission just looked up would leave its page row pointing
+            # at freed (soon re-owned) pages — cross-request contamination
+            now = self._next_tick()
+            for e in hits:
+                e.refs += 1
+                e.tick = now
+            fresh = self._alloc(total - len(hits))
+            if fresh is None:
+                for e in hits:
+                    e.refs -= 1
+                with self._cond:
+                    self._slots[slot] = None
+                    self._free.append(slot)
+                    self._waiting.appendleft(req)
+                    _mx.set_gauge("serving.engine.queue",
+                                  len(self._waiting))
+                return
+            st = self._slots[slot]
+            st.entries = list(hits)
+            st.private = list(fresh)
+            row = np.zeros(self._max_pages, np.int32)
+            row[:len(hits)] = [e.page for e in hits]
+            row[len(hits):total] = fresh
+            if hits:
+                _mx.inc("serving.prefix_hits")
+                _mx.inc("serving.prefix_hit_pages", len(hits))
+            elif self._prefix_on:
+                _mx.inc("serving.prefix_misses")
+            self._admissions.append(_Admission(
+                req, slot, row, len(hits) * ps, keys, len(hits), total))
+            _mx.inc("serving.engine.admissions")
+
+    def _advance_admissions(self, pending: deque) -> None:
+        """ONE prefill chunk per engine iteration, round-robin across
+        in-flight admissions — decode steps interleave between chunks
+        (active slots keep advancing through a long prompt's prefill) and
+        a short prompt admitted beside a long one reaches its first token
+        after its OWN chunks, not the long one's."""
+        self._start_admissions()
+        if not self._admissions:
+            return
+        adm = self._admissions.popleft()
+        req = adm.req
+        plen = len(req.tokens)
+        cap = self._prefill_chunk or self.max_len
+        clen = min(cap, plen - adm.t0)
+        # chunk buffers bucket to powers of two below the chunk cap, so
+        # the remainder chunk reuses a bounded program set
+        cb = min(_bucket(clen, pow2_cap=cap), cap)
+        buf = np.zeros((1, cb), np.int32)
+        buf[0, :clen] = req.tokens[adm.t0:adm.t0 + clen]
+        final = adm.t0 + clen == plen
+        limit = plen + req.max_new - 1
+        with recorder.span("serving.engine.admit", slot=adm.slot,
+                           prompt=plen, t0=adm.t0, chunk=clen,
+                           final=final):
+            self._carry, first = self._admit_jit(
+                self.params, self.adapters, self._carry,
+                jnp.asarray(buf), jnp.int32(adm.t0), jnp.int32(clen),
+                jnp.int32(adm.slot), jnp.asarray(adm.row),
+                jnp.float32(req.temperature), jnp.uint32(req.seed),
+                jnp.int32(limit), jnp.bool_(final), jnp.int32(plen))
+        _mx.inc("serving.engine.prefill_chunks")
+        if final:
+            self._register_prefix(adm)
+            pending.append(("admit", adm.slot, first))
+        else:
+            adm.t0 += clen
+            self._admissions.append(adm)
+
+    def _register_prefix(self, adm: _Admission) -> None:
+        """Publish the request's full prompt pages into the prefix map AT
+        ADMISSION (not retirement): a concurrent identical prompt hits
+        while this one still decodes — the system-prompt traffic shape.
+        Full pages are immutable from here on (decode writes start at
+        pos >= prompt_len, which lands strictly past them). A page whose
+        key already exists (two identical prompts admitted concurrently)
+        stays private — content-identical, so the resident entry serves
+        future hits and ours is simply freed at retirement."""
+        if not self._prefix_on:
+            return
+        st = self._slots[adm.slot]
+        if st is None:   # raced a crash/stop reset
+            return
+        full = len(adm.req.tokens) // self._page_size
+        for i in range(adm.hit_pages, full):
+            if adm.keys[i] in self._prefix:
+                continue
+            page = int(adm.row[i])
+            parent = adm.keys[i - 1] if i else None
+            ent = _PrefixEntry(page, parent, self._next_tick())
+            self._prefix[adm.keys[i]] = ent
+            if parent is not None and parent in self._prefix:
+                self._prefix[parent].kids += 1
+            st.entries.append(ent)
+            st.private.remove(page)
+
     # -------------------------------------------------------------- draining
     def _drain(self, frame: tuple) -> None:
         """Materialize one queued frame and route its tokens. This is the
@@ -537,6 +977,13 @@ class DecodeEngine:
                 _mx.observe("serving.tbt",
                             (now - st.t_first) / (len(st.out) - 1))
             st.req.ticket._tokens = st.out
+            st.req.ticket.t_done = now
+            if self._paged:
+                # release BEFORE the done event: a waiter returning from
+                # result() (the diagnosis probe, capacity tests) must
+                # observe the pool already reclaimed — releasing after
+                # set() leaves a window where free+resident < budget
+                self._release_slot_pages(st)
             st.req.ticket._done.set()
             with self._cond:
                 self._slots[slot] = None
@@ -554,6 +1001,13 @@ class DecodeEngine:
             slots = [s for s in self._slots if s is not None]
             self._slots = [None] * self.n_slots
             self._free = list(range(self.n_slots))
+        if self._paged:
+            # the device cache is garbage after a crash — every page and
+            # every cached prefix goes with it
+            self._admissions.clear()
+            self._free_pages = list(range(1, self._n_pages))
+            self._prefix.clear()
+            _mx.set_gauge("serving.kv_pages_free", len(self._free_pages))
         # last-value-wins gauges would otherwise report the pre-crash
         # depth/occupancy forever
         _mx.set_gauge("serving.engine.queue", 0)

@@ -95,11 +95,13 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
                                   ) -> "GreedyLMPredictor":
     """THE serve-knob -> GreedyLMPredictor mapping (decode_slots,
     engine_max_len, engine_eos_id, engine_fetch_chunk, sampler_cache_size,
-    kv_cache), shared by the config route (serving.lm_predictor_from_config
-    reads Config.serve_args.extra) and the deploy route
-    (scheduler.start_replica reads the spec's serve dict) — one mapping,
-    so the two surfaces cannot drift."""
+    kv_cache, engine_mp, kv_page_size, kv_n_pages, prefill_chunk,
+    prefix_cache), shared by the config route
+    (serving.lm_predictor_from_config reads Config.serve_args.extra) and
+    the deploy route (scheduler.start_replica reads the spec's serve
+    dict) — one mapping, so the two surfaces cannot drift."""
     eos = sv.get("engine_eos_id")
+    n_pages = sv.get("kv_n_pages")
     return GreedyLMPredictor(
         model, params, adapters=adapters, detokenize=detokenize,
         max_len=int(sv.get("engine_max_len", default_max_len)),
@@ -108,7 +110,11 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
         eos_id=None if eos is None else int(eos),
         engine_fetch_chunk=int(sv.get("engine_fetch_chunk", 2)),
         sampler_cache_size=int(sv.get("sampler_cache_size", 4)),
-        engine_mp=int(sv.get("engine_mp", 0)))
+        engine_mp=int(sv.get("engine_mp", 0)),
+        kv_page_size=int(sv.get("kv_page_size", 0)),
+        kv_n_pages=None if n_pages is None else int(n_pages),
+        prefill_chunk=int(sv.get("prefill_chunk", 0)),
+        prefix_cache=bool(sv.get("prefix_cache", True)))
 
 
 def _bucket(n: int, pow2_cap: int = 1024) -> int:
@@ -186,7 +192,15 @@ class GreedyLMPredictor(_InstrumentedPredictor):
     SAME device steps instead of serializing — single-prompt requests
     without top_k route there (greedy output token-identical to the
     per-request path); batched and top_k requests keep the per-request
-    path. stop() shuts the engine down."""
+    path. stop() shuts the engine down.
+
+    kv_page_size=P (requires decode_slots) swaps the engine's cache for
+    the block/PAGED layout — kv_n_pages sizes the pool, prefill_chunk
+    enables chunked-prefill admission, prefix_cache reuses identical
+    prompt-prefix pages (engine module docstring has the full story);
+    engine capacity then becomes the page budget, consulted through
+    engine.admissible() so routing and the 400/degrade contracts follow
+    the real constraint."""
 
     def __init__(self, model, params: Pytree,
                  detokenize: Optional[Callable[[list[int]], str]] = None,
@@ -195,7 +209,9 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                  compute_dtype: Optional[str] = None,
                  decode_slots: int = 0, eos_id: Optional[int] = None,
                  sampler_cache_size: int = 4, engine_fetch_chunk: int = 2,
-                 engine_mp: int = 0):
+                 engine_mp: int = 0, kv_page_size: int = 0,
+                 kv_n_pages: Optional[int] = None, prefill_chunk: int = 0,
+                 prefix_cache: bool = True):
         self.model = model
         self.params = params
         self.detokenize = detokenize
@@ -210,6 +226,12 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 "decode_slots (the continuous-batching engine, "
                 "serving/engine.py) needs kv_cache=True — the engine IS "
                 "the KV-cached decode with a slot axis")
+        if (kv_page_size or kv_n_pages or prefill_chunk) \
+                and not decode_slots:
+            raise ValueError(
+                "kv_page_size/kv_n_pages/prefill_chunk configure the "
+                "PAGED decode engine — they need decode_slots > 0 "
+                "(otherwise they would be silently ignored)")
 
         if adapters is not None and not kv_cache:
             # the recompute path drives model.apply, which knows nothing of
@@ -304,7 +326,10 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                     model, self.params, adapters=self.adapters,
                     n_slots=int(decode_slots), max_len=max_len,
                     eos_id=eos_id, dtype=kv_dtype,
-                    fetch_chunk=engine_fetch_chunk, mesh=mesh).start()
+                    fetch_chunk=engine_fetch_chunk, mesh=mesh,
+                    page_size=kv_page_size, n_pages=kv_n_pages,
+                    prefill_chunk=prefill_chunk,
+                    prefix_cache=prefix_cache).start()
             return
 
         # n_steps is a Python int at trace time (scan length must be
@@ -373,9 +398,27 @@ class GreedyLMPredictor(_InstrumentedPredictor):
         # request blocks on its ticket while OTHER requests decode in the
         # same device steps. Batched rows (already one program) and top_k
         # requests (need a static-k compiled cutoff) stay on the
-        # per-request path. Engine capacity is exact (prompt + max_new <=
-        # max_len — no step bucketing), checked by submit().
+        # per-request path. Capacity rides the ENGINE's oracle
+        # (engine.admissible — exact prompt + max_new <= max_len, plus
+        # the page budget in paged mode), not static max_len math: a
+        # request the page budget refuses falls through to the
+        # per-request path below when that path can serve it honestly,
+        # instead of 400ing a request this replica could answer. Routing
+        # is deterministic per (prompt_len, max_new) — admissible() is
+        # budget math, not current occupancy — so a given request shape
+        # always takes the same path (seeded sampling stays reproducible).
         if (self.engine is not None and not batched
+                and int(input_json.get("top_k", 0) or 0) == 0
+                and not self.engine.admissible(len(rows[0]), max(new, 1))):
+            if self.eos_id is not None or len(rows[0]) + _bucket(
+                    max(new, 1), pow2_cap=self.max_len) > self.max_len:
+                # neither path can serve this honestly (the per-request
+                # path has no eos support / its bucketed capacity is also
+                # exceeded) — surface the ENGINE's contract, page math
+                # included, rather than the per-request message
+                raise InvalidRequest(
+                    self.engine.capacity_error(len(rows[0]), max(new, 1)))
+        elif (self.engine is not None and not batched
                 and int(input_json.get("top_k", 0) or 0) == 0):
             seed = int(input_json["seed"]) if "seed" in input_json else None
             gen = None

@@ -75,7 +75,9 @@ def start_replica(spec: dict):
         # LLM replica: llm/TransformerLM + GreedyLMPredictor. "lm" carries
         # the model recipe, "serve" the ServeArgs.extra knobs (config.py) —
         # decode_slots > 0 brings the replica up on the continuous-batching
-        # engine (serving/engine.py), otherwise per-request decode.
+        # engine (serving/engine.py), otherwise per-request decode;
+        # kv_page_size > 0 selects the engine's paged KV cache (with
+        # kv_n_pages/prefill_chunk/prefix_cache riding the same dict).
         from ..llm.transformer import TransformerLM
         from .predictor import lm_predictor_from_serve_knobs
 

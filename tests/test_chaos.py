@@ -542,9 +542,15 @@ def test_diagnosis_includes_chaos_smoke(capsys):
 
     from fedml_tpu.__main__ import main
 
-    rc = main(["diagnosis"])
+    # --only runs just this probe: the full battery (every transport +
+    # three engine smokes) already runs once in test_cli_platform — a
+    # second full pass here bought ~30s of tier-1 wall clock for no
+    # added coverage
+    rc = main(["diagnosis", "--only", "chaos_smoke"])
     out = json.loads(capsys.readouterr().out)
     assert "chaos_smoke" in out["checks"]
     assert out["checks"]["chaos_smoke"]["ok"], out["checks"]["chaos_smoke"]
     assert out["checks"]["chaos_smoke"]["faults_injected"] > 0
     assert rc == 0
+    # an unknown probe name is refused loudly
+    assert main(["diagnosis", "--only", "chaos_smok"]) == 2

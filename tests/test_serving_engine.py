@@ -40,39 +40,58 @@ def _setup(seed=0):
     return model, params
 
 
+@pytest.fixture(scope="module")
+def setup():
+    return _setup()
+
+
+@pytest.fixture(scope="module")
+def per_req(setup):
+    """Shared per-request reference predictor — its compiled programs
+    (the `want` side of every equivalence pin below) are reused across
+    the module instead of recompiling per test."""
+    model, params = setup
+    return GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True)
+
+
+@pytest.fixture(scope="module")
+def eng_shared(setup):
+    """Shared 2-slot contiguous engine for the tests that don't need a
+    bespoke knob (eos/fetch_chunk/slot-count pins build their own). The
+    conftest swaps a fresh metrics registry per test, so counter
+    assertions on the shared engine stay per-test."""
+    model, params = setup
+    eng = DecodeEngine(model, params, n_slots=2, max_len=MAXLEN).start()
+    yield eng
+    eng.stop()
+
+
 def _prompts(ns, seed=0):
     rs = np.random.RandomState(seed)
     return [rs.randint(1, V, n).tolist() for n in ns]
 
 
 # ----------------------------------------------------------- equivalence
-def test_engine_greedy_token_identical_to_per_request_path():
+def test_engine_greedy_token_identical_to_per_request_path(per_req,
+                                                           eng_shared):
     """PINNED equivalence: 5 prompts of different lengths and different
     token budgets through 2 slots — requests are admitted mid-flight as
     earlier ones retire at different steps, and every output must equal
     the per-request path's, token for token."""
-    model, params = _setup()
     prompts = _prompts((6, 10, 8, 5, 7))
     budgets = [4, 7, 5, 6, 3]
-    per_req = GreedyLMPredictor(model, params, max_len=MAXLEN,
-                                kv_cache=True)
     want = [per_req.predict({"tokens": p, "max_new_tokens": b})
             ["generated_tokens"] for p, b in zip(prompts, budgets)]
-
-    eng = DecodeEngine(model, params, n_slots=2, max_len=MAXLEN).start()
-    try:
-        tickets = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
-        got = [t.result(timeout=120) for t in tickets]
-    finally:
-        eng.stop()
+    tickets = [eng_shared.submit(p, b) for p, b in zip(prompts, budgets)]
+    got = [t.result(timeout=120) for t in tickets]
     assert got == want
 
 
-def test_engine_program_set_bounded_retrace_guard():
+def test_engine_program_set_bounded_retrace_guard(setup):
     """One step program total; one admit program per prompt bucket. A
     second wave of requests (same buckets, new temperatures/seeds — all
     traced) must not add a single compile."""
-    model, params = _setup()
+    model, params = setup
     eng = DecodeEngine(model, params, n_slots=3, max_len=MAXLEN).start()
     try:
         prompts = _prompts((6, 10, 3, 12))   # buckets 8, 16, 4, 16
@@ -90,11 +109,9 @@ def test_engine_program_set_bounded_retrace_guard():
         eng.stop()
 
 
-def test_engine_eos_retires_slot_early():
-    model, params = _setup()
+def test_engine_eos_retires_slot_early(setup, per_req):
+    model, params = setup
     prompt = _prompts((8,))[0]
-    per_req = GreedyLMPredictor(model, params, max_len=MAXLEN,
-                                kv_cache=True)
     want = per_req.predict({"tokens": prompt, "max_new_tokens": 8})
     want = want["generated_tokens"]
     eos = want[2]
@@ -109,53 +126,42 @@ def test_engine_eos_retires_slot_early():
     assert got == want[:want.index(eos) + 1]
 
 
-def test_engine_single_token_and_capacity_contract():
-    model, params = _setup()
+def test_engine_single_token_and_capacity_contract(per_req, eng_shared):
     prompt = _prompts((9,))[0]
-    per_req = GreedyLMPredictor(model, params, max_len=MAXLEN,
-                                kv_cache=True)
     want = per_req.predict({"tokens": prompt, "max_new_tokens": 1})
-    eng = DecodeEngine(model, params, n_slots=1, max_len=MAXLEN).start()
-    try:
-        # max_new=1: the prefill's token is the whole answer (no steps)
-        assert eng.submit(prompt, 1).result(timeout=120) == \
-            want["generated_tokens"]
-        # exact capacity: prompt + max_new == max_len is admitted...
-        ok = eng.submit(prompt, MAXLEN - len(prompt))
-        assert len(ok.result(timeout=120)) == MAXLEN - len(prompt)
-        # ...one more is refused loudly (no step bucketing in the contract)
-        with pytest.raises(ValueError, match="slot capacity"):
-            eng.submit(prompt, MAXLEN - len(prompt) + 1)
-        with pytest.raises(ValueError, match="at least one prompt token"):
-            eng.submit([], 4)
-    finally:
-        eng.stop()
+    # max_new=1: the prefill's token is the whole answer (no steps)
+    assert eng_shared.submit(prompt, 1).result(timeout=120) == \
+        want["generated_tokens"]
+    # exact capacity: prompt + max_new == max_len is admitted...
+    ok = eng_shared.submit(prompt, MAXLEN - len(prompt))
+    assert len(ok.result(timeout=120)) == MAXLEN - len(prompt)
+    # ...one more is refused loudly (no step bucketing in the contract)
+    with pytest.raises(ValueError, match="slot capacity"):
+        eng_shared.submit(prompt, MAXLEN - len(prompt) + 1)
+    with pytest.raises(ValueError, match="at least one prompt token"):
+        eng_shared.submit([], 4)
 
 
-def test_engine_sampling_seeded():
+def test_engine_sampling_seeded(eng_shared):
     """Same seed -> same tokens; different seeds at high temperature
     diverge; greedy slots and sampling slots coexist in the same steps."""
-    model, params = _setup()
     prompt = _prompts((8,))[0]
-    eng = DecodeEngine(model, params, n_slots=3, max_len=MAXLEN).start()
-    try:
-        greedy = eng.submit(prompt, 8).result(timeout=120)
-        a = eng.submit(prompt, 8, temperature=3.0, seed=7)
-        b = eng.submit(prompt, 8, temperature=3.0, seed=7)
-        c = eng.submit(prompt, 8, temperature=3.0, seed=8)
-        a, b, c = (t.result(timeout=120) for t in (a, b, c))
-        assert a == b
-        assert a != c
-        # and greedy again, mid-sampling-load, still the pinned sequence
-        assert eng.submit(prompt, 8).result(timeout=120) == greedy
-    finally:
-        eng.stop()
+    greedy = eng_shared.submit(prompt, 8).result(timeout=120)
+    a = eng_shared.submit(prompt, 8, temperature=3.0, seed=7)
+    b = eng_shared.submit(prompt, 8, temperature=3.0, seed=7)
+    c = eng_shared.submit(prompt, 8, temperature=3.0, seed=8)
+    a, b, c = (t.result(timeout=120) for t in (a, b, c))
+    assert a == b
+    assert a != c
+    # and greedy again, mid-sampling-load, still the pinned sequence
+    assert eng_shared.submit(prompt, 8).result(timeout=120) == greedy
 
 
 def test_engine_serves_qlora_layout():
     """int8 frozen base + LoRA adapters (the QLoRA serving layout) through
     the engine: token-identical to the per-request kv path on the same
-    quantized tree."""
+    quantized tree. (Prompts share one bucket — the layout is what's
+    under test here; bucket diversity is pinned above.)"""
     from fedml_tpu.llm.lora import lora_init
     from fedml_tpu.llm.quant import quantize_tree_int8
 
@@ -163,7 +169,7 @@ def test_engine_serves_qlora_layout():
     ads = lora_init(jax.random.key(1), params, rank=4, a_std=0.3)
     ads = jax.tree.map(lambda a: a + 0.05 * jnp.ones_like(a), ads)
     qparams = quantize_tree_int8(params)
-    prompts = _prompts((7, 9, 6))
+    prompts = _prompts((7, 6, 5))
     per_req = GreedyLMPredictor(model, qparams, max_len=MAXLEN,
                                 kv_cache=True, adapters=ads)
     want = [per_req.predict({"tokens": p, "max_new_tokens": 5})
@@ -179,10 +185,10 @@ def test_engine_serves_qlora_layout():
 
 
 # ------------------------------------------------------ predictor routing
-def test_predictor_engine_route_and_fallbacks():
-    model, params = _setup()
+def test_predictor_engine_route_and_fallbacks(setup, per_req):
+    model, params = setup
     prompt = _prompts((9,))[0]
-    plain = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True)
+    plain = per_req
     eng = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True,
                             decode_slots=2)
     try:
@@ -218,12 +224,12 @@ def test_predictor_engine_route_and_fallbacks():
         eng.stop()
 
 
-def test_engine_hostile_seed_and_dead_engine_fallback():
+def test_engine_hostile_seed_and_dead_engine_fallback(setup):
     """Review hardening: (a) an out-of-uint32-range client seed must not
     crash the engine thread (it is masked, still deterministic); (b) after
     the engine stops, routed requests degrade to the per-request path
     instead of queueing into a dead loop."""
-    model, params = _setup()
+    model, params = setup
     prompt = _prompts((7,))[0]
     pred = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True,
                              decode_slots=2)
@@ -267,18 +273,15 @@ def test_engine_hostile_seed_and_dead_engine_fallback():
         eosp.predict({"tokens": prompt, "max_new_tokens": 4})
 
 
-def test_engine_telemetry_contract():
+def test_engine_telemetry_contract(eng_shared):
     """serving.ttft/tbt histograms, serving.tokens_total counter,
-    serving.slots_active gauge, and engine spans on the recorder."""
+    serving.slots_active gauge, and engine spans on the recorder (the
+    conftest's per-test registry/recorder swap keeps the counts exact on
+    the shared engine)."""
     from fedml_tpu.utils.events import recorder
 
-    model, params = _setup()
-    eng = DecodeEngine(model, params, n_slots=4, max_len=MAXLEN).start()
-    try:
-        tickets = [eng.submit(p, 6) for p in _prompts((8, 6, 9, 7))]
-        outs = [t.result(timeout=120) for t in tickets]
-    finally:
-        eng.stop()
+    tickets = [eng_shared.submit(p, 6) for p in _prompts((8, 6, 9, 7))]
+    outs = [t.result(timeout=120) for t in tickets]
     snap = _mx.snapshot()
     assert snap["counters"]["serving.tokens_total"] == sum(
         len(o) for o in outs) == 24
@@ -294,14 +297,14 @@ def test_engine_telemetry_contract():
     assert "serving.engine.fetch" in spans
 
 
-def test_http_concurrency_through_engine_runner():
+def test_http_concurrency_through_engine_runner(setup):
     """8 concurrent HTTP requests through FedMLInferenceRunner on an
     engine-backed predictor: every request gets exactly one response,
     more than one slot is concurrently active at some point, and the
     in-flight gauge returns to zero (atomic counter satellite)."""
     from fedml_tpu.serving.inference_runner import FedMLInferenceRunner
 
-    model, params = _setup()
+    model, params = setup
     pred = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True,
                              decode_slots=4)
     runner = FedMLInferenceRunner(pred, port=0).start()
@@ -348,10 +351,10 @@ def test_http_concurrency_through_engine_runner():
 
 
 # ------------------------------------------------------------- satellites
-def test_sampler_cache_lru_bounded():
+def test_sampler_cache_lru_bounded(setup):
     """A diverse stream of top_k values cannot grow the per-top_k jit
     cache without limit: LRU cap + eviction counter."""
-    model, params = _setup()
+    model, params = setup
     pred = GreedyLMPredictor(model, params, max_len=MAXLEN, kv_cache=True,
                              sampler_cache_size=2)
     prompt = _prompts((6,))[0]
@@ -413,13 +416,13 @@ def test_serve_args_config_validation():
         {"serve": {"kv_cache": False}}).serve_args.extra["kv_cache"] is False
 
 
-def test_lm_predictor_from_config_consumes_serve_args():
+def test_lm_predictor_from_config_consumes_serve_args(setup, per_req):
     """cfg.serve_args is actually consumed (not just validated): the
     config bridge builds an engine-backed predictor from YAML knobs."""
     from fedml_tpu.config import Config
     from fedml_tpu.serving import lm_predictor_from_config
 
-    model, params = _setup()
+    model, params = setup
     cfg = Config.from_dict({"serve": {"decode_slots": 2,
                                       "engine_max_len": MAXLEN,
                                       "engine_fetch_chunk": 3,
@@ -431,9 +434,7 @@ def test_lm_predictor_from_config_consumes_serve_args():
         assert pred.engine.fetch_chunk == 3
         assert pred._samplers_cap == 2
         prompt = _prompts((7,))[0]
-        want = GreedyLMPredictor(model, params, max_len=MAXLEN,
-                                 kv_cache=True).predict(
-            {"tokens": prompt, "max_new_tokens": 4})
+        want = per_req.predict({"tokens": prompt, "max_new_tokens": 4})
         assert pred.predict({"tokens": prompt, "max_new_tokens": 4}) == want
     finally:
         pred.stop()
@@ -442,11 +443,11 @@ def test_lm_predictor_from_config_consumes_serve_args():
     assert plain.engine is None
 
 
-def test_slots_active_gauge_returns_to_zero_fetch_chunk_1():
+def test_slots_active_gauge_returns_to_zero_fetch_chunk_1(setup):
     """Regression: with fetch_chunk=1 the final completing frame's ENTRY
     mask is nonzero and no trailing all-inactive frame is dispatched — a
     gauge published from entry masks would read busy forever at idle."""
-    model, params = _setup()
+    model, params = setup
     eng = DecodeEngine(model, params, n_slots=2, max_len=MAXLEN,
                        fetch_chunk=1).start()
     try:
@@ -499,17 +500,15 @@ def test_runner_maps_server_errors_to_500():
         runner.stop()
 
 
-def test_start_replica_lm_spec_with_engine(tmp_path):
+def test_start_replica_lm_spec_with_engine(tmp_path, setup, per_req):
     """Deploy-path wiring: a serve spec with model_kind=lm and
     serve.decode_slots brings up an engine-backed LM replica whose
     /predict matches the per-request path."""
     from fedml_tpu.serving.scheduler import start_replica
 
-    model, params = _setup()
+    model, params = setup
     prompt = _prompts((7,))[0]
-    want = GreedyLMPredictor(model, params, max_len=MAXLEN,
-                             kv_cache=True).predict(
-        {"tokens": prompt, "max_new_tokens": 5})
+    want = per_req.predict({"tokens": prompt, "max_new_tokens": 5})
     spec = {"model_kind": "lm",
             "lm": {"vocab_size": V, "d_model": D, "n_layers": L,
                    "n_heads": H, "d_ff": FF, "scan_layers": True},
