@@ -781,6 +781,134 @@ def bench_serving_paged(quick: bool = False) -> dict:
     }
 
 
+def bench_sim_scale(quick: bool = False) -> dict:
+    """Parrot-scale simulation rows (ISSUE 8): a 1024-client CPU round run
+    chunked+streamed vs single-shot.
+
+    - `sim_scale_hbm_headroom_ratio`: device-resident training-data bytes,
+      single-shot (full stacked dataset) over chunked (chunk x double
+      buffer) — the memory wall the chunked engine removes. Bar >= 4x at
+      cohort/chunk = 8 with prefetch 1.
+    - `sim_scale_ingest_overhead_pct`: chunked WITH prefetch vs chunked
+      synchronous — the overlap machinery must not cost; budget < 2% (like
+      the telemetry/reliability rows).
+    - `sim_scale_chunked_vs_unchunked_pct`: chunked+prefetch vs single-shot
+      rounds/s at this (small) scale. Documented budget: <= 25% on CPU —
+      the chunked path pays per-chunk dispatch + host gather, which the
+      prefetch thread hides from the transfer side only; at Parrot scale
+      the single-shot path does not RUN (cohort exceeds device memory), so
+      this is the regression guard for the always-available small case.
+    - `sim_scale_costlpt_makespan_ratio`: cost-model-LPT over size-LPT
+      makespan on a skewed synthetic cohort (per-client lognormal speeds x
+      pareto sizes — the cross-device heterogeneity Parrot schedules for).
+      Bar <= 0.95 (>= 5% better); size-LPT balances sample counts, which
+      misranks slow-small clients.
+    """
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+
+    n_clients = 256 if quick else 1024
+    chunk = n_clients // 16
+
+    def cfg(extra=None):
+        return fedml_tpu.init(config={
+            "common_args": {"training_type": "simulation", "random_seed": 0},
+            "data_args": {"dataset": "synthetic",
+                          "extra": {"synthetic_samples_per_client": 32}},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": n_clients,
+                "client_num_per_round": n_clients,
+                "comm_round": 4, "epochs": 2, "batch_size": 16,
+                "learning_rate": 0.1,
+                "extra": {"clients_per_device_parallel": 8,
+                          **(extra or {})},
+            },
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "sp"},
+        })
+
+    out = {"sim_scale_clients": n_clients, "sim_scale_cohort_chunk": chunk}
+    sim_u = Simulator(cfg())
+    sim_c = Simulator(cfg({"cohort_chunk": chunk, "ingest_prefetch": 1}))
+    sim_s = Simulator(cfg({"cohort_chunk": chunk, "ingest_prefetch": 0}))
+    for s in (sim_u, sim_c, sim_s):
+        s.run_round(0)     # compile + warm
+    # INTERLEAVED best-of-reps: these are threaded wall-clock loops (the
+    # ingest worker and XLA's compute threads share the host cores on a
+    # CPU box), so background load drifts; round-robin keeps every variant
+    # exposed to the same conditions and the best-of discards hiccups —
+    # same discipline as the reliability row.
+    best = {id(sim_u): float("inf"), id(sim_c): float("inf"),
+            id(sim_s): float("inf")}
+    r, n = 1, 3
+    for _ in range(4):
+        for s in (sim_u, sim_c, sim_s):
+            t0 = time.perf_counter()
+            for k in range(n):
+                s.run_round(r + k)
+            best[id(s)] = min(best[id(s)],
+                              (time.perf_counter() - t0) / n)
+        r += n
+    dt_u, dt_c, dt_s = best[id(sim_u)], best[id(sim_c)], best[id(sim_s)]
+    device_bytes_u = sum(int(v.nbytes) for v in sim_u.data.values())
+    # resident chunk bytes: the consumed chunk + the prefetched chunk + one
+    # in flight inside the queue hand-off (conservative x3)
+    chunk_bytes = sum(
+        int(v[:chunk].nbytes) for v in sim_c._host_data.values())
+    del sim_u, sim_c, sim_s
+
+    out.update({
+        "sim_scale_unchunked_rounds_per_sec": round(1.0 / dt_u, 2),
+        "sim_scale_chunked_rounds_per_sec": round(1.0 / dt_c, 2),
+        "sim_scale_chunked_vs_unchunked_pct": round(
+            max(dt_c / dt_u - 1.0, 0.0) * 100, 2),
+        "sim_scale_chunked_budget_pct": 25.0,
+        "sim_scale_ingest_overhead_pct": round(
+            max(dt_c / dt_s - 1.0, 0.0) * 100, 2),
+        "sim_scale_ingest_budget_pct": 2.0,
+        "sim_scale_hbm_headroom_ratio": round(
+            device_bytes_u / (3 * chunk_bytes), 2),
+        "sim_scale_device_bytes_unchunked": device_bytes_u,
+        "sim_scale_device_bytes_chunked_resident": 3 * chunk_bytes,
+    })
+
+    # ---- cost-model-aware LPT vs size-LPT on a skewed synthetic cohort
+    # (host-side scheduling math only — no jax). True per-client runtime =
+    # lognormal speed x samples: the size scheduler misranks slow-small
+    # clients; the engaged cost model schedules on observed runtimes.
+    import numpy as np
+
+    from fedml_tpu import schedule as sched
+
+    rs = np.random.RandomState(7)
+    m, workers = 256, 8
+    sizes = np.maximum(1, (rs.pareto(2.0, m) * 20).astype(int))
+    speeds = rs.lognormal(0.0, 0.5, m)
+    true_t = speeds * sizes
+    cm = sched.CostModel({i: int(s) for i, s in enumerate(sizes)},
+                         fit_after_rounds=2, error_threshold=2.0)
+    engaged_cold = cm.engaged()
+    for i in range(m):      # two uniform observation rounds (Parrot warm-up)
+        cm.record_dispatch([i], float(true_t[i]))
+        cm.record_dispatch([i], float(true_t[i]))
+    assert not engaged_cold and cm.engaged(), "cost model gating broken"
+
+    def makespan(costs):
+        blocks = sched.balanced_lpt(np.asarray(costs, float), workers)
+        return max(sum(true_t[j] for j in b) for b in blocks)
+
+    ms_size = makespan(sizes)
+    ms_cost = makespan(cm.predict_costs(range(m)))
+    out.update({
+        "sim_scale_costlpt_makespan_ratio": round(ms_cost / ms_size, 3),
+        "sim_scale_costlpt_bar": 0.95,
+        "sim_scale_costlpt_fit_error": round(cm._fitted()[1], 3),
+    })
+    return out
+
+
 def bench_workload4_hierarchical() -> dict:
     """BASELINE workload 4: hierarchical cross-silo — per-silo inner
     allreduce (intra axis) + outer aggregate (silos axis), one XLA program
@@ -1353,6 +1481,10 @@ _HEADLINE_KEYS = (
     "serving_paged_ttft_p99_ms_chunked",
     "serving_paged_ttft_p99_ms_monolithic",
     "serving_paged_prefix_hit_flatness_224_over_64",
+    # Parrot-scale cohorts (ISSUE 8): chunked/streamed rounds + cost-LPT
+    "sim_scale_hbm_headroom_ratio", "sim_scale_ingest_overhead_pct",
+    "sim_scale_chunked_vs_unchunked_pct",
+    "sim_scale_costlpt_makespan_ratio",
     "w4_hier_round_time_ms",
     # LLM rows: 1.2B and the 7B ceiling
     "fedllm_1b_tokens_per_sec", "fedllm_1b_mfu_vs_spec_peak",
@@ -1412,6 +1544,8 @@ def main():
                {"serving_cb_error": "bench_serving_cb failed twice"})
     acc.update(_retrying(bench_serving_paged, quick, default=None) or
                {"serving_paged_error": "bench_serving_paged failed twice"})
+    acc.update(_retrying(bench_sim_scale, quick, default=None) or
+               {"sim_scale_error": "bench_sim_scale failed twice"})
     if not quick:
         # fresh-interpreter subprocess (forced-2-device jax cold start +
         # two engine compiles) — too heavy for the quick lane

@@ -74,6 +74,12 @@ def init(config_path: str | None = None, config: Config | dict | None = None,
 
     cfg.apply_data_silo_config(
         Path(config_path).expanduser().parent if config_path else None)
+    # the ONE deliberate global-seed site (reference parity: fedml.init
+    # seeds host RNGs once at entry so user code is reproducible). Library
+    # code must never reseed the global numpy RNG mid-run — round-seeded
+    # sampling uses local RandomState instances (simulator.sample_clients,
+    # parity.py) so chaos/async/data draws sharing np.random stay on the
+    # stream this line establishes.
     random.seed(cfg.common_args.random_seed)
     np.random.seed(cfg.common_args.random_seed)
     logging.basicConfig(level=logging.INFO)

@@ -390,6 +390,33 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             f"staleness: n={st['count']} mean={st['sum'] / st['count']:.2f} "
             f"p50<={p50:g} p99<={p99:g}")
 
+    # --------------------------------------------- chunked-cohort ingest
+    # (ISSUE 8: cohort_chunk streaming — simulation/ingest.py)
+    if c.get("fed_ingest_chunks_total"):
+        n_ch = int(c["fed_ingest_chunks_total"])
+        seg = (f"ingest: chunks {n_ch}  "
+               f"{_fmt_bytes(c.get('fed_ingest_bytes_total', 0))}  "
+               f"prefetched {int(c.get('fed_ingest_prefetched_total', 0))}"
+               f"/{n_ch}")
+        ph = h.get("fed_ingest_put_s")
+        if ph and ph["count"]:
+            p50 = histogram_percentile(ph["buckets"], 0.5)
+            if p50 is not None:
+                seg += f"  put_p50<={p50 * 1e3:.2f}ms"
+        br = rate("fed_ingest_bytes_total")
+        if br is not None:
+            seg += f"  {_fmt_bytes(br)}/s"
+        lines.append(seg)
+    # cost model renders on its own: it runs without chunking too (async
+    # loop, mesh-less sync sim — both record and refresh the gauges)
+    if "fed_cost_model_fit_error" in g:
+        err = g["fed_cost_model_fit_error"]
+        lines.append(
+            "cost_model: "
+            + ("ENGAGED" if g.get("fed_cost_model_engaged") else "warming")
+            + (f"  fit_err {err:.2f}" if err >= 0 else "  fit_err inf")
+            + f"  dispatches {int(c.get('fed_cost_model_dispatches_total', 0))}")
+
     # ----------------------------------------------------------------- comm
     backends = sorted({k.split("_")[1] for k in c
                        if k.startswith("comm_") and "_bytes_" in k})
@@ -526,6 +553,97 @@ def cmd_top(args) -> int:
             _time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0        # ^C is the documented way to stop a live watch
+
+
+def _forced_2dev_subprocess(child_src: str, label: str,
+                            timeout: int = 240) -> dict:
+    """Run `child_src` in a fresh interpreter whose host CPU platform is
+    FORCED to 2 devices (this process's jax is already initialized, so the
+    forced-device flag must be set before a new interpreter boots). The
+    child must print one JSON object as its last stdout line. Shared by
+    every diagnosis probe that needs a real multi-device mesh on a
+    single-device host."""
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    env = {**_os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+           "PYTHONPATH": _os.pathsep.join(
+               [str(_Path(__file__).resolve().parent.parent)]
+               + ([_os.environ["PYTHONPATH"]]
+                  if _os.environ.get("PYTHONPATH") else []))}
+    r = _sp.run([_sys.executable, "-c", child_src], capture_output=True,
+                text=True, timeout=timeout, env=env)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"forced-2-device {label} child failed: {r.stderr[-300:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _cohort_sharded_check() -> dict:
+    """Shared body of the `cohort_sharded_smoke` diagnosis probe, importable
+    so the forced-2-device subprocess runs the IDENTICAL check this process
+    runs when it already has a multi-device platform: a 2-chunk streamed
+    cohort round over a real `clients` mesh must be bitwise the single-shot
+    round (history AND params), with ingest overlap observed and a bounded
+    chunk-program count."""
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.simulation.simulator import Simulator
+    from fedml_tpu.utils import metrics as mx
+
+    d = len(jax.devices())
+    m = 2 * d
+
+    def cfg(extra=None):
+        return fedml_tpu.init(config={
+            "common_args": {"training_type": "simulation", "random_seed": 0},
+            "data_args": {"dataset": "synthetic",
+                          "extra": {"synthetic_samples_per_client": 8}},
+            "model_args": {"model": "lr"},
+            "train_args": {"federated_optimizer": "FedAvg",
+                           "client_num_in_total": m,
+                           "client_num_per_round": m,
+                           "comm_round": 2, "epochs": 1, "batch_size": 8,
+                           "learning_rate": 0.1, "extra": extra or {}},
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "xla"},
+        })
+
+    before = mx.snapshot()["counters"]
+    chk = Simulator(cfg({"cohort_chunk": d, "ingest_prefetch": 1}))
+    if chk.mesh is None or chk.mesh.devices.size != d:
+        raise RuntimeError("chunked sim did not build the client mesh")
+    chk.run()
+    after = mx.snapshot()["counters"]
+    chunks = (after.get("fed.ingest.chunks", 0)
+              - before.get("fed.ingest.chunks", 0))
+    prefetched = (after.get("fed.ingest.prefetched", 0)
+                  - before.get("fed.ingest.prefetched", 0))
+    ref = Simulator(cfg())
+    ref.run()
+    if ref.history != chk.history:
+        raise ValueError("chunked round history diverged from single-shot")
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(ref.server_state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(chk.server_state.params))):
+        if not np.array_equal(a, b):
+            raise ValueError("chunked params not bitwise-identical to the "
+                             "single-shot round")
+    if chunks < 4:   # 2 rounds x 2 chunks each
+        raise ValueError(f"expected >=4 streamed chunks, saw {chunks}")
+    if prefetched < 1:
+        raise ValueError("ingest never overlapped compute: no chunk was "
+                         "resident before the consumer asked")
+    n_chunk = chk.chunk_fn._fn._cache_size()
+    if n_chunk != 1:
+        raise ValueError(f"chunk program retraced: {n_chunk} compiles")
+    return {"devices": d, "chunks": int(chunks),
+            "prefetched": int(prefetched), "params_bitwise": True}
 
 
 def cmd_diagnosis(args) -> int:
@@ -796,11 +914,6 @@ def cmd_diagnosis(args) -> int:
         # devices, else in a subprocess whose host platform is FORCED to
         # 2 devices (this process's jax is already initialized, so the
         # forced-device flag must be set before a fresh interpreter boots)
-        import os as _os
-        import subprocess as _sp
-        import sys as _sys
-        from pathlib import Path as _Path
-
         import jax as _jax
         import jax.numpy as _jnp
 
@@ -846,20 +959,27 @@ def cmd_diagnosis(args) -> int:
             "assert len(wq.sharding.device_set) == 2, wq.sharding\n"
             "print(json.dumps({'devices': len(jax.devices()),\n"
             "                  'wq_spec': str(wq.sharding.spec)}))\n")
-        env = {**_os.environ, "JAX_PLATFORMS": "cpu",
-               "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-               "PYTHONPATH": _os.pathsep.join(
-                   [str(_Path(__file__).resolve().parent.parent)]
-                   + ([_os.environ["PYTHONPATH"]]
-                      if _os.environ.get("PYTHONPATH") else []))}
-        r = _sp.run([_sys.executable, "-c", child], capture_output=True,
-                    text=True, timeout=240, env=env)
-        if r.returncode != 0:
-            raise RuntimeError(
-                f"forced-2-device mesh child failed: {r.stderr[-300:]}")
-        mesh_child = json.loads(r.stdout.strip().splitlines()[-1])
+        mesh_child = _forced_2dev_subprocess(child, "mesh")
         return {"resolved_params": len(_jax.tree_util.tree_leaves(specs)),
                 **mesh_child, "mode": "forced-2-device subprocess"}
+
+    def cohort_sharded_smoke():
+        # the Parrot-scale simulation plane end-to-end (ISSUE 8): a
+        # chunked+streamed cohort round over a REAL multi-device mesh ==
+        # the single-shot round bitwise, with ingest overlap observed.
+        # In-process when this host already has >= 2 devices; otherwise a
+        # forced-2-device subprocess (same pattern as partition_rules_smoke
+        # — this process's jax platform is already initialized).
+        import jax as _jax
+
+        if len(_jax.devices()) >= 2:
+            return {**_cohort_sharded_check(), "mode": "in-process"}
+        child = (
+            "import json\n"
+            "from fedml_tpu.__main__ import _cohort_sharded_check\n"
+            "print(json.dumps(_cohort_sharded_check()))\n")
+        return {**_forced_2dev_subprocess(child, "cohort"),
+                "mode": "forced-2-device subprocess"}
 
     probes = {"jax": jax_devices, "wire_codec": wire,
               "loopback_transport": loopback, "grpc_transport": grpc,
@@ -867,10 +987,11 @@ def cmd_diagnosis(args) -> int:
               "chaos_smoke": chaos_smoke,
               "serving_engine_smoke": serving_engine_smoke,
               "serving_paged_smoke": serving_paged_smoke,
-              "partition_rules_smoke": partition_rules_smoke}
+              "partition_rules_smoke": partition_rules_smoke,
+              "cohort_sharded_smoke": cohort_sharded_smoke}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
-                "partition_rules_smoke")
+                "partition_rules_smoke", "cohort_sharded_smoke")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)

@@ -304,9 +304,12 @@ def logout() -> bool:
     return False
 
 
-def fedml_diagnosis() -> dict:
+def fedml_diagnosis(only=None) -> dict:
     """reference: api fedml_diagnosis — connectivity probes; local: the
-    CLI's transport/device checks, returned as a dict."""
+    CLI's transport/device checks, returned as a dict. `only` selects a
+    probe subset by name (the CLI's `diagnosis --only` flag) — the full
+    battery costs ~30s of smoke runs."""
+    import argparse
     import io
     from contextlib import redirect_stdout
 
@@ -314,5 +317,5 @@ def fedml_diagnosis() -> dict:
 
     buf = io.StringIO()
     with redirect_stdout(buf):
-        cmd_diagnosis(None)
+        cmd_diagnosis(argparse.Namespace(only=list(only) if only else None))
     return json.loads(buf.getvalue())

@@ -310,6 +310,72 @@ class Config:
                 raise ValueError(
                     f"train_args.{knob} must be an integer >= {lo}; "
                     f"got {val!r}")
+        # Parrot-scale simulation knobs (ISSUE 8): cohort_chunk streams an
+        # m-client round through HBM-bounded chunks (simulation/simulator.py
+        # chunked driver), ingest_prefetch sizes the double-buffered
+        # host->device pipeline (simulation/ingest.py), cost_model switches
+        # LPT costs to fitted runtimes (schedule.CostModel). Validated here
+        # so a typo'd YAML fails at load, not chunks into a run.
+        for knob, lo in (("cohort_chunk", 1), ("ingest_prefetch", 0)):
+            val = t.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = (not isinstance(val, bool)
+                      and int(val) == float(val) and int(val) >= lo)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"train_args.{knob} must be an integer >= {lo}; "
+                    f"got {val!r}")
+        # ingest_prefetch only takes effect inside the chunked driver —
+        # without cohort_chunk it would be silently ignored; refuse at load
+        # (same gating discipline as the paged-KV serve knobs)
+        if t.extra.get("ingest_prefetch") is not None \
+                and not t.extra.get("cohort_chunk"):
+            raise ValueError(
+                "train_args.ingest_prefetch requires cohort_chunk — the "
+                "streaming ingest pipeline only exists for chunked rounds; "
+                "without it the knob would be silently ignored")
+        cm = t.extra.get("cost_model")
+        if cm not in (None, False, True):
+            if not isinstance(cm, dict):
+                raise ValueError(
+                    "train_args.cost_model must be a boolean or a dict of "
+                    f"{{fit_after_rounds, error_threshold}}; got {cm!r}")
+            unknown_cm = set(cm) - {"fit_after_rounds", "error_threshold"}
+            if unknown_cm:
+                raise ValueError(
+                    f"unknown cost_model knob(s) {sorted(unknown_cm)}; "
+                    "valid: ['error_threshold', 'fit_after_rounds']")
+            far = cm.get("fit_after_rounds")
+            if far is not None and (isinstance(far, bool)
+                                    or not isinstance(far, int) or far < 1):
+                raise ValueError(
+                    "cost_model.fit_after_rounds must be an integer >= 1; "
+                    f"got {far!r}")
+            et = cm.get("error_threshold")
+            if et is not None:
+                try:
+                    ok = not isinstance(et, bool) and float(et) > 0
+                except (TypeError, ValueError):
+                    ok = False
+                if not ok:
+                    raise ValueError(
+                        "cost_model.error_threshold must be a positive "
+                        f"number; got {et!r}")
+        # in-jit health stats cannot ride chunked rounds (the cosine stat
+        # needs the full update stack — parallel/round.build_chunk_fns);
+        # an EXPLICIT health_stats=true alongside cohort_chunk is refused
+        # here, while the default-on value silently degrades in the
+        # simulator (documented in README "Scale-out simulation")
+        if t.extra.get("cohort_chunk") and t.extra.get("health_stats") is True:
+            raise ValueError(
+                "train_args.health_stats=true cannot be combined with "
+                "cohort_chunk: per-client health stats need the full "
+                "update stack the chunked engine exists to avoid "
+                "materializing")
         # run-health export plane (utils/prometheus.py): /metrics endpoint
         # port. Validated at load so a typo'd YAML fails before a run
         # silently comes up unscrapeable.

@@ -103,7 +103,24 @@ def _per_client_loss(mets) -> jax.Array:
             / jnp.maximum(mets.count, 1.0))
 
 
-def _make_round_body(
+class RoundParts(NamedTuple):
+    """The round engine decomposed into its chunk-streamable pieces
+    (ISSUE 8 tentpole). `round_body` is zero_carry + one chunk_body call +
+    finalize_body fused into one traceable function — so the chunked driver
+    (simulation/simulator.py cohort_chunk) executes EXACTLY the arithmetic
+    the single-shot program executes, just split across jit calls with the
+    partial-aggregate carry crossing the host. That structural identity is
+    what makes chunked == unchunked bit-identical: the per-device weighted
+    sums accumulate group-by-group in the same order either way, and the
+    one cross-device reduction happens once, at finalize, in both."""
+    zero_carry: Callable      # (server_state, full_cstates, ids, shards) -> carry
+    chunk_body: Callable      # (carry, server_state, shards, ids, w, rng, off) -> carry
+    finalize_body: Callable   # (server_state, carry, ids, w, rng, hook_state) -> RoundOutput
+    round_body: Callable      # the fused single-shot body (build_round_fn)
+    make_carry: Callable      # host-side zero-carry allocator (chunked driver)
+
+
+def make_round_parts(
     alg: FedAlgorithm,
     mesh: Optional[Mesh] = None,
     axis: str = "clients",
@@ -115,9 +132,11 @@ def _make_round_body(
     health_stats: bool = False,
     client_dropout: float = 0.0,
     client_straggler: float = 0.0,
-) -> Callable:
-    """Build the traceable round body shared by `build_round_fn` (one round
-    per jit call) and `build_block_fn` (K rounds scanned inside one jit).
+) -> RoundParts:
+    """Build the traceable round pieces shared by `build_round_fn` (one round
+    per jit call), `build_block_fn` (K rounds scanned inside one jit), and
+    `build_chunk_fns` (an m-client cohort streamed through HBM-bounded
+    chunks, ISSUE 8).
 
     round_fn(server_state, full_client_states, data, ids, weights, rng,
              hook_state) -> RoundOutput
@@ -176,22 +195,118 @@ def _make_round_body(
         def aggregate_full(stacked, w, ctx):
             return tu.tree_weighted_mean(stacked, w), ctx["state"]
 
+    # what must be materialized per client: FULL hooks need every update
+    # stacked; the health plane needs stacked updates + per-client metrics.
+    # Pure LINEAR aggregation needs NEITHER — the weighted sums accumulate
+    # in the scan carry, so HBM holds O(group) updates instead of O(cohort).
+    collect_upds = use_full or health_stats
+    collect_cmets = bool(health_stats)
+    has_cstate = alg.client_state_init is not None
+    chaos_on = client_dropout > 0.0 or client_straggler > 0.0
+    dv = int(mesh.devices.size) if mesh is not None else 1
+
     def one_client(bcast, shard, cstate, rng, weight):
         upd, new_state, met = alg.client_update(bcast, shard, cstate, rng)
         if postprocess_update is not None:
             upd = postprocess_update(upd, rng)
         return upd, new_state, met
 
-    def run_clients(bcast, shards, cstates, rngs, weights):
-        """Scan over local clients (leading axis), G-way vmapped chunks.
-        Returns (stacked updates, new states, summed metrics)."""
+    def client_structs(server_state, full_cstates, shards):
+        """(upd, nstate, met) ShapeDtypeStructs of ONE client — the leaf
+        shapes the accumulator carry is built from. Abstract eval only, so
+        it works on tracers (fused body), concrete arrays, and
+        ShapeDtypeStructs (host-side make_carry) alike."""
+        bc = jax.eval_shape(alg.broadcast, server_state)
+        sh1 = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), shards)
+        cs1 = (jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), full_cstates)
+            if has_cstate else jax.ShapeDtypeStruct((), jnp.float32))
+        key = jax.eval_shape(lambda: jax.random.key(0))
+        w = jax.ShapeDtypeStruct((), jnp.float32)
+        return jax.eval_shape(one_client, bc, sh1, cs1, key, w)
+
+    def zero_carry(server_state, full_cstates, ids, shards):
+        """The partial-aggregate carry at the start of a round: per-device
+        weighted-sum accumulators (leading axis = mesh size, so the one
+        cross-device reduction can happen once, at finalize), the stacked
+        [m] collection buffers the FULL/health paths fill chunk by chunk
+        via dynamic_update_slice, and the client-state plane: the cohort's
+        states are gathered HERE, at round start — every chunk computes
+        from pre-round state (exactly as the single-shot gather does), new
+        states buffer into `ns` per chunk, and ONE scatter at finalize
+        commits them. Scattering per chunk instead would corrupt state
+        when a mesh-pad duplicate lands in a later chunk than its source:
+        the duplicate would recompute from its source's ALREADY-UPDATED
+        state and overwrite the real update with a second step."""
+        m = ids.shape[0]
+        upd_s, ns_s, met_s = client_structs(server_state, full_cstates,
+                                            shards)
+        carry = {
+            # FULL mode aggregates from the stacked buffer, so the weighted
+            # sum accumulators would be dead weight (params x mesh) threaded
+            # through every donated chunk call — empty subtrees instead
+            "num": (jax.tree.map(
+                lambda s: jnp.zeros((dv,) + s.shape, s.dtype), upd_s)
+                if not use_full else {}),
+            "den": (jnp.zeros((dv,), jnp.float32) if not use_full else {}),
+            "msum": jax.tree.map(
+                lambda s: jnp.zeros((dv,) + s.shape, s.dtype), met_s),
+            "cstates": full_cstates,
+            "bufs": {},
+        }
+        if has_cstate:
+            carry["bufs"]["cs"] = jax.tree.map(
+                lambda a: jnp.take(a, ids, axis=0), full_cstates)
+            carry["bufs"]["ns"] = jax.tree.map(
+                lambda s: jnp.zeros((m,) + s.shape, s.dtype), ns_s)
+        if collect_upds:
+            carry["bufs"]["u"] = jax.tree.map(
+                lambda s: jnp.zeros((m,) + s.shape, s.dtype), upd_s)
+        if collect_cmets:
+            carry["bufs"]["m"] = jax.tree.map(
+                lambda s: jnp.zeros((m,) + s.shape, s.dtype), met_s)
+        return carry
+
+    def make_carry(server_state, full_cstates, ids, chunk_struct):
+        """Host-side zero-carry allocator for the chunked driver (once per
+        round). `ids` is the full padded [m] cohort row; chunk_struct: the
+        ShapeDtypeStruct tree of ONE chunk's {"x","y","mask"} (client axis
+        leading). Accumulators and collection buffers are placed client-/
+        device-sharded so every chunk program updates them in place
+        (donated)."""
+        carry = zero_carry(server_state, full_cstates, jnp.asarray(ids),
+                           chunk_struct)
+        if mesh is not None:
+            sh = NamedSharding(mesh, P(axis))
+            rep = NamedSharding(mesh, P())
+            # commit EVERY leaf (accumulators/buffers client-sharded, the
+            # full client-state tree replicated): the jit cache keys on
+            # input shardings, so an uncommitted first-round carry would
+            # buy one extra compile per program before the layouts the
+            # chunk outputs carry become the steady state
+            carry = {
+                k: jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, sh if k in ("num", "den", "msum", "bufs") else rep),
+                    v)
+                for k, v in carry.items()
+            }
+        return carry
+
+    def run_clients_acc(bcast, shards, cstates, rngs, weights, acc, bufs, off):
+        """Scan over local clients (leading axis) in G-way vmapped groups,
+        accumulating the weighted update sum / weight sum / metric sums into
+        `acc` ([1, ...]-leading local accumulator slices) and writing any
+        collected stacks into `bufs` at local row `off`. Returns
+        (acc, stacked new states, bufs)."""
         m_local = shards["y"].shape[0]
         g = max(1, min(group_size, m_local))
         while m_local % g:  # largest divisor of m_local not exceeding group_size
             g -= 1
         n_groups = m_local // g
 
-        def body(_, inp):
+        def body(car, inp):
             sh, cs, rg, w = inp
             upd, ns, met = jax.vmap(one_client, in_axes=(None, 0, 0, 0, 0))(
                 bcast, sh, cs, rg, w
@@ -199,79 +314,158 @@ def _make_round_body(
             # zero-weight clients are mesh-padding duplicates (simulator
             # _pad_ids); keep them out of the reported training metrics
             met = jax.tree.map(lambda a: a * (w > 0).astype(a.dtype), met)
-            return None, (upd, ns, met)
+            num, den, ms = car
+            if not use_full:
+                # weight-premultiplied group sum folded into the carry — the
+                # NCCL-sim reduce (common.py:197-207) restructured as a
+                # sequential accumulation so a chunk boundary (ISSUE 8)
+                # cannot change the addition order
+                num = jax.tree.map(
+                    lambda n, u: n + jnp.sum(
+                        u * w.reshape((-1,) + (1,) * (u.ndim - 1)).astype(
+                            u.dtype),
+                        axis=0)[None],
+                    num, upd)
+                den = den + jnp.sum(w)[None]
+            ms = jax.tree.map(
+                lambda a, b: a + jnp.sum(b, axis=0)[None], ms, met)
+            ys = {"ns": ns}
+            if collect_upds:
+                ys["u"] = upd
+            if collect_cmets:
+                ys["m"] = met
+            return (num, den, ms), ys
 
         grouped = jax.tree.map(
             lambda a: a.reshape((n_groups, g) + a.shape[1:]),
             (shards, cstates, rngs, weights),
         )
-        _, (upds, nstates, mets) = jax.lax.scan(body, None, grouped)
-        ungroup = lambda a: a.reshape((m_local,) + a.shape[2:])
-        return (
-            jax.tree.map(ungroup, upds),
-            jax.tree.map(ungroup, nstates),
-            jax.tree.map(ungroup, mets),
-        )
+        acc, ys = jax.lax.scan(body, acc, grouped)
+        ungroup = lambda t: jax.tree.map(
+            lambda a: a.reshape((m_local,) + a.shape[2:]), t)
+        nstates = ungroup(ys["ns"])
+        if collect_upds:
+            bufs = {**bufs, "u": jax.tree.map(
+                lambda b, u: jax.lax.dynamic_update_slice_in_dim(b, u, off, 0),
+                bufs["u"], ungroup(ys["u"]))}
+        if collect_cmets:
+            bufs = {**bufs, "m": jax.tree.map(
+                lambda b, u: jax.lax.dynamic_update_slice_in_dim(b, u, off, 0),
+                bufs["m"], ungroup(ys["m"]))}
+        return acc, nstates, bufs
 
-    def finalize(server_state, agg, mets: ClientMetrics, new_states_full,
-                 hook_state, health=None, faults=None):
-        new_server = alg.server_update(server_state, agg)
-        n = jnp.maximum(mets.count, 1.0)
-        metrics = {
-            "train_loss": mets.loss_sum / n,
-            "train_acc": mets.correct / n,
-            "n_samples": mets.count,
-        }
-        if health:
-            metrics["health"] = health
-        if faults:
-            metrics["faults"] = faults
-        return RoundOutput(new_server, new_states_full, metrics, hook_state)
+    def fault_masks(rng, ids):
+        """Seeded per-client fault draws, keyed by client id — a chunk's
+        draws are bit-identical to the same ids' draws in the single-shot
+        program, and a mesh-padding duplicate shares its source's fate."""
+        frng = jax.random.fold_in(rng, 0xFA17)
 
-    def round_body(server_state, full_cstates, data, ids, weights, rng, hook_state):
+        def fault_mask(rate, salt):
+            if rate <= 0.0:
+                return jnp.zeros(ids.shape, bool)
+            r = jax.random.fold_in(frng, salt)
+            return jax.vmap(lambda i: jax.random.bernoulli(
+                jax.random.fold_in(r, i), rate))(ids)
+
+        dropped = fault_mask(client_dropout, 1)
+        # a crashed client can't also straggle; keep the masks disjoint
+        straggled = jnp.logical_and(fault_mask(client_straggler, 2),
+                                    jnp.logical_not(dropped))
+        keep = jnp.logical_not(jnp.logical_or(dropped, straggled))
+        return dropped, straggled, keep
+
+    def chunk_body(carry, server_state, shards, ids, weights, rng, off):
+        """Accumulate one cohort chunk into the carry. `off` is the
+        PER-DEVICE row offset of this chunk inside the round's stacked
+        buffers (traced, so one compiled chunk program serves every chunk
+        index). The chunk's clients are laid out per-device: rows
+        [k*c, (k+1)*c) belong to device k — the same client→device
+        assignment the single-shot program gives them, which is what keeps
+        per-device accumulation order (and therefore results) bit-identical
+        to the unchunked path. Client states are READ from the round-start
+        gather (carry bufs "cs") and new states buffered into "ns" — never
+        scattered mid-round, so a pad duplicate in a later chunk cannot
+        observe (and corrupt) its source's already-updated state."""
         bcast = alg.broadcast(server_state)
-        shards = {
-            "x": jnp.take(data["x"], ids, axis=0),
-            "y": jnp.take(data["y"], ids, axis=0),
-            "mask": jnp.take(data["mask"], ids, axis=0),
-        }
-        has_cstate = alg.client_state_init is not None
-        cstates = (
-            jax.tree.map(lambda a: jnp.take(a, ids, axis=0), full_cstates)
-            if has_cstate
-            else jnp.zeros((ids.shape[0],))
-        )
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
-        agg_rng = jax.random.fold_in(rng, 0x5EC)
+        keep = jnp.ones(ids.shape, bool)
+        if chaos_on:
+            # zeroed weight = lost report on every WEIGHT-DRIVEN aggregate:
+            # the carry accumulates only survivor-weighted sums, so the
+            # aggregate renormalizes over survivors at finalize with no
+            # host round-trip and no shape change (see finalize_body for
+            # the weight-IGNORING full-set aggregator contract)
+            _, _, keep = fault_masks(rng, ids)
+            weights = weights * keep.astype(weights.dtype)
+        acc = (carry["num"], carry["den"], carry["msum"])
+        bufs = carry["bufs"]
 
-        # ------------------------- chaos plane: in-jit client-fault masks
+        def run_chunk(bc, sh, rg, w, kp, a, bf, o):
+            """Per-device chunk work: slice this chunk's pre-round client
+            states, scan the clients, fault-restore, and write the new
+            states into the round buffer at `o`."""
+            c_local = sh["y"].shape[0]
+            cs = (jax.tree.map(
+                lambda b: jax.lax.dynamic_slice_in_dim(b, o, c_local, 0),
+                bf["cs"]) if has_cstate else jnp.zeros((c_local,)))
+            a, ns, bf = run_clients_acc(bc, sh, cs, rg, w, a, bf, o)
+            if has_cstate:
+                if chaos_on:
+                    # a faulted client's report was lost: its persistent
+                    # state (SCAFFOLD c_i, FedDyn h_i, ...) must keep the
+                    # pre-round value, exactly as if never dispatched
+                    ns = jax.tree.map(
+                        lambda new, old: jnp.where(
+                            kp.reshape((-1,) + (1,) * (new.ndim - 1)),
+                            new, old),
+                        ns, cs)
+                bf = {**bf, "ns": jax.tree.map(
+                    lambda b, n: jax.lax.dynamic_update_slice_in_dim(
+                        b, n, o, 0),
+                    bf["ns"], ns)}
+            return a, bf
+
+        if mesh is None:
+            acc, bufs = run_chunk(bcast, shards, rngs, weights, keep,
+                                  acc, bufs, off)
+        else:
+            spec_c, spec_r = P(axis), P()
+
+            @functools.partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c, spec_c,
+                          spec_c, spec_r),
+                out_specs=(spec_c, spec_c),
+            )
+            def block(bc, sh, rg, w, kp, a, bf, o):
+                # Mark the replicated broadcast as device-varying before any
+                # differentiation: shard_map treats grads w.r.t. replicated
+                # values as global (auto-psum across the mesh), but local SGD
+                # needs per-client gradients. pcast/pvary localizes the copy.
+                bc = _localize(bc, axis)
+                o = _localize(o, axis)
+                return run_chunk(bc, sh, rg, w, kp, a, bf, o)
+
+            acc, bufs = block(bcast, shards, rngs, weights, keep,
+                              acc, bufs, off)
+        out = dict(carry)
+        out["num"], out["den"], out["msum"] = acc
+        out["bufs"] = bufs
+        return out
+
+    def finalize_body(server_state, carry, ids, weights, rng, hook_state):
+        """Close the round: ONE cross-device reduction of the accumulated
+        per-device partials, the FULL-mode hook over the collected stack,
+        post-processing, the server step, and the metrics row."""
+        agg_rng = jax.random.fold_in(rng, 0x5EC)
         faults = None
         keep = None
-        if client_dropout > 0.0 or client_straggler > 0.0:
-            frng = jax.random.fold_in(rng, 0xFA17)
-
-            def fault_mask(rate, salt):
-                if rate <= 0.0:
-                    return jnp.zeros(ids.shape, bool)
-                r = jax.random.fold_in(frng, salt)
-                return jax.vmap(lambda i: jax.random.bernoulli(
-                    jax.random.fold_in(r, i), rate))(ids)
-
-            dropped = fault_mask(client_dropout, 1)
-            # a crashed client can't also straggle; keep the masks disjoint
-            straggled = jnp.logical_and(fault_mask(client_straggler, 2),
-                                        jnp.logical_not(dropped))
-            keep = jnp.logical_not(jnp.logical_or(dropped, straggled))
-            # zeroed weight = lost report on every WEIGHT-DRIVEN aggregate
-            # (the weighted-mean paths and the default FULL hook): the
-            # aggregate renormalizes over survivors and faulted clients'
-            # metrics are masked out in run_clients — no host round-trip,
-            # no shape change. Weight-IGNORING full-set aggregators
-            # (coordinate median, krum selection, ...) cannot shrink their
-            # static-shape cohort this way; they receive the mask as
-            # ctx["fault_keep"] below and must exclude faulted rows
-            # themselves — until they do, a faulted client's update still
-            # influences such statistics.
+        if chaos_on:
+            # recomputed over the full [m] row — draws are keyed by client
+            # id, so these are bit-for-bit the masks the chunks drew (and
+            # in the fused body XLA CSEs the two computations away)
+            dropped, straggled, keep = fault_masks(rng, ids)
             weights = weights * keep.astype(weights.dtype)
             faults = {"dropped": dropped.astype(jnp.float32),
                       "straggled": straggled.astype(jnp.float32)}
@@ -279,123 +473,74 @@ def _make_round_body(
                "params": server_state.params}
         if keep is not None:
             # FULL-mode hooks that ignore weights (median/krum families)
-            # need the survivor mask explicitly — see the note above
+            # need the survivor mask explicitly: static shapes cannot
+            # shrink the cohort, so weight-IGNORING aggregators must honor
+            # ctx["fault_keep"] themselves
             ctx["fault_keep"] = keep
-
-        def call_full(upds, w):
+        if use_full:
+            upds = carry["bufs"]["u"]
             mr = num_real_clients
             if mr is not None and mr < ids.shape[0]:
-                upds = jax.tree.map(lambda a: a[:mr], upds)
-                w = w[:mr]
+                # mesh-padding duplicates must not bias unweighted
+                # statistics (krum distances, medians): slice the real
+                # prefix before invoking the hook
+                u = jax.tree.map(lambda a: a[:mr], upds)
+                w_ = weights[:mr]
                 cx = {**ctx, "ids": ids[:mr]}
                 if keep is not None:
                     cx["fault_keep"] = keep[:mr]
             else:
-                cx = ctx
-            return aggregate_full(upds, w, cx)
-
-        health = None
-        if mesh is None:
-            upds, nstates, mets = run_clients(bcast, shards, cstates, rngs, weights)
-            if use_full:
-                agg, hook_state = call_full(upds, weights)
-            else:
-                agg = tu.tree_weighted_mean(upds, weights)
-            summed = jax.tree.map(lambda a: a.sum(0), mets)
-            if health_stats:
-                health = _client_health(upds, agg, _per_client_loss(mets),
-                                        summed)
-        elif use_full:
-            spec_c, spec_r = P(axis), P()
-
-            @functools.partial(
-                shard_map,
-                mesh=mesh,
-                in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
-                out_specs=(spec_c, spec_c, spec_r, spec_c),
-            )
-            def block_full(bc, sh, cs, rg, w):
-                bc = _localize(bc, axis)
-                upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
-                summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
-                # per-client mean loss leaves the shard_map client-sharded
-                # so the health stats can join it with the jit-level
-                # aggregate; an empty dict when health is off (out_specs
-                # are a pytree prefix, so {} matches spec_c trivially)
-                loss_c = ({"loss": _per_client_loss(mets)}
-                          if health_stats else {})
-                return upds, nstates, summed, loss_c
-
-            # stacked updates come back client-sharded; the defense/attack
-            # pipeline runs at the jit level, where GSPMD inserts whatever
-            # collectives its ops need (gram matmuls for pairwise distances
-            # ride the ICI all-gather) — no manual all_gather, and the result
-            # is provably replicated for the server update.
-            upds, nstates, summed, loss_c = block_full(
-                bcast, shards, cstates, rngs, weights)
-            agg, hook_state = call_full(upds, weights)
-            if health_stats:
-                health = _client_health(upds, agg, loss_c["loss"], summed)
+                u, w_, cx = upds, weights, ctx
+            agg, hook_state = aggregate_full(u, w_, cx)
         else:
-            spec_c, spec_r = P(axis), P()
-
-            @functools.partial(
-                shard_map,
-                mesh=mesh,
-                in_specs=(spec_r, spec_c, spec_c, spec_c, spec_c),
-                out_specs=(spec_r, spec_c, spec_r, spec_c),
-            )
-            def block(bc, sh, cs, rg, w):
-                # Mark the replicated broadcast as device-varying before any
-                # differentiation: shard_map treats grads w.r.t. replicated
-                # values as global (auto-psum across the mesh), but local SGD
-                # needs per-client gradients. pcast/pvary localizes the copy.
-                bc = _localize(bc, axis)
-                upds, nstates, mets = run_clients(bc, sh, cs, rg, w)
-                # weight-premultiplied local sum, then one psum — the
-                # NCCL-sim reduce (common.py:197-207) as an XLA collective
-                num = jax.tree.map(
-                    lambda a: jnp.sum(
-                        a * w.reshape((-1,) + (1,) * (a.ndim - 1)).astype(a.dtype),
-                        axis=0,
-                    ),
-                    upds,
-                )
-                num = jax.lax.psum(num, axis)
-                den = jax.lax.psum(jnp.sum(w), axis)
-                agg = jax.tree.map(lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
-                summed = jax.lax.psum(jax.tree.map(lambda a: a.sum(0), mets), axis)
-                # the stacked updates never leave the shard_map in LINEAR
-                # mode, so the per-client health stats are computed HERE,
-                # where updates, the replicated aggregate, and the psum'd
-                # cohort metrics all coexist; they exit client-sharded
-                h = (_client_health(upds, agg, _per_client_loss(mets),
-                                    summed) if health_stats else {})
-                return agg, nstates, summed, h
-
-            agg, nstates, summed, health = block(
-                bcast, shards, cstates, rngs, weights)
-            health = health or None
-
+            num = jax.tree.map(lambda a: jnp.sum(a, axis=0), carry["num"])
+            den = jnp.sum(carry["den"])
+            agg = jax.tree.map(
+                lambda a: a / jnp.maximum(den, 1e-12).astype(a.dtype), num)
+        summed = jax.tree.map(lambda a: jnp.sum(a, axis=0), carry["msum"])
+        health = None
+        if health_stats:
+            health = _client_health(
+                carry["bufs"]["u"], agg,
+                _per_client_loss(carry["bufs"]["m"]), summed)
         if postprocess_agg is not None:
             agg = postprocess_agg(agg, ctx)
+        new_server = alg.server_update(server_state, agg)
+        n = jnp.maximum(summed.count, 1.0)
+        metrics = {
+            "train_loss": summed.loss_sum / n,
+            "train_acc": summed.correct / n,
+            "n_samples": summed.count,
+        }
+        if health:
+            metrics["health"] = health
+        if faults:
+            metrics["faults"] = faults
+        full_cstates = carry["cstates"]
         if has_cstate:
-            if keep is not None:
-                # a faulted client's report was lost: its persistent state
-                # (SCAFFOLD c_i, FedDyn h_i, ...) must keep the pre-round
-                # value, exactly as if it had never been dispatched
-                nstates = jax.tree.map(
-                    lambda new, old: jnp.where(
-                        keep.reshape((-1,) + (1,) * (new.ndim - 1)),
-                        new, old),
-                    nstates, cstates)
+            # the ONE client-state scatter of the round: every buffered row
+            # was computed from pre-round state, so pad duplicates write
+            # values bit-identical to their source rows (order-independent)
             full_cstates = jax.tree.map(
-                lambda full, new: full.at[ids].set(new), full_cstates, nstates
-            )
-        return finalize(server_state, agg, summed, full_cstates, hook_state,
-                        health, faults)
+                lambda full, new: full.at[ids].set(new),
+                carry["cstates"], carry["bufs"]["ns"])
+        return RoundOutput(new_server, full_cstates, metrics, hook_state)
 
-    return round_body
+    def round_body(server_state, full_cstates, data, ids, weights, rng,
+                   hook_state):
+        shards = {
+            "x": jnp.take(data["x"], ids, axis=0),
+            "y": jnp.take(data["y"], ids, axis=0),
+            "mask": jnp.take(data["mask"], ids, axis=0),
+        }
+        carry = zero_carry(server_state, full_cstates, ids, shards)
+        carry = chunk_body(carry, server_state, shards, ids, weights, rng,
+                           jnp.zeros((), jnp.int32))
+        return finalize_body(server_state, carry, ids, weights, rng,
+                             hook_state)
+
+    return RoundParts(zero_carry, chunk_body, finalize_body, round_body,
+                      make_carry)
 
 
 def build_round_fn(
@@ -411,13 +556,13 @@ def build_round_fn(
     client_dropout: float = 0.0,
     client_straggler: float = 0.0,
 ) -> Callable:
-    """Build the jitted single-round function (see `_make_round_body` for the
+    """Build the jitted single-round function (see `make_round_parts` for the
     argument contract)."""
-    round_body = _make_round_body(
+    round_body = make_round_parts(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
         postprocess_agg, num_real_clients, health_stats,
         client_dropout, client_straggler,
-    )
+    ).round_body
     # donate server/client/hook state: all three are dead after the call, and
     # the hook state can be a [N, D] defense history that must update in place.
     # track_jit keeps PR 1's retrace guard on as a metric: gauge
@@ -457,11 +602,11 @@ def build_block_fn(
     keep the block shape fixed across calls (the simulator runs ragged tail
     blocks through the per-round path) or pay a retrace per distinct K.
     """
-    round_body = _make_round_body(
+    round_body = make_round_parts(
         alg, mesh, axis, group_size, aggregate_full, postprocess_update,
         postprocess_agg, num_real_clients, health_stats,
         client_dropout, client_straggler,
-    )
+    ).round_body
 
     def block_body(server_state, full_cstates, data, ids, weights, base_rng,
                    rounds, hook_state):
@@ -481,6 +626,65 @@ def build_block_fn(
     # aliases the donated buffers so K rounds update state in place
     return track_jit(jax.jit(block_body, donate_argnums=(0, 1, 7)),
                      "block_fn")
+
+
+def build_chunk_fns(
+    alg: FedAlgorithm,
+    mesh: Optional[Mesh] = None,
+    axis: str = "clients",
+    group_size: int = 1,
+    aggregate_full: Optional[Callable[[Pytree, jax.Array, dict], tuple]] = None,
+    postprocess_update: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
+    postprocess_agg: Optional[Callable[[Pytree, dict], Pytree]] = None,
+    num_real_clients: Optional[int] = None,
+    health_stats: bool = False,
+    client_dropout: float = 0.0,
+    client_straggler: float = 0.0,
+) -> tuple[Callable, Callable, Callable]:
+    """Chunked-cohort execution (ISSUE 8 tentpole): the round split into
+    HBM-bounded jit calls so a cohort is bounded by HOST RAM, not device
+    memory. Returns (chunk_fn, finalize_fn, make_carry):
+
+      make_carry(server_state, full_cstates, m, chunk_struct) -> carry
+      chunk_fn(carry, server_state, chunk_data, chunk_ids, chunk_weights,
+               rng, offset) -> carry                         [donates carry]
+      finalize_fn(server_state, carry, ids, weights, rng, hook_state)
+               -> RoundOutput          [donates server_state, carry, hook]
+
+    The driver (simulation/simulator.py) host-gathers each chunk's client
+    data and streams it in (double-buffered — simulation/ingest.py); the
+    partial aggregate rides the donated carry across chunk calls; finalize
+    performs the ONE cross-device reduction, the server step, and the
+    metrics row. Because `round_body` is literally make_carry + one
+    chunk_body + finalize_body fused, the chunked path is bit-identical to
+    the single-shot program (pinned in tests/test_sim_scale.py) whenever
+    the padded cohort, the LPT schedule row, and the client-group size
+    line up — which they do for any cohort divisible by the chunk size.
+
+    Caveats: in-jit health stats cannot ride chunked rounds (the cosine-
+    to-aggregate stat needs every update against the FINAL aggregate; the
+    chunked engine's whole point is not materializing the cohort), so
+    health_stats is rejected here. FULL-mode aggregation still works —
+    the updates ARE materialized into the carry's stacked buffer, so only
+    the DATA transfer is chunk-bounded, not update memory (that is
+    inherent to full-set aggregators).
+    """
+    if health_stats:
+        raise ValueError(
+            "health_stats cannot ride chunked rounds: cosine-to-aggregate "
+            "needs the full update stack; run unchunked or disable "
+            "train_args.extra.health_stats")
+    parts = make_round_parts(
+        alg, mesh, axis, group_size, aggregate_full, postprocess_update,
+        postprocess_agg, num_real_clients, health_stats,
+        client_dropout, client_straggler,
+    )
+    chunk_fn = track_jit(jax.jit(parts.chunk_body, donate_argnums=(0,)),
+                         "chunk_fn")
+    finalize_fn = track_jit(
+        jax.jit(parts.finalize_body, donate_argnums=(0, 1, 5)),
+        "finalize_fn")
+    return chunk_fn, finalize_fn, parts.make_carry
 
 
 def shard_fed_data(data: dict, mesh: Optional[Mesh], axis: str = "clients") -> dict:

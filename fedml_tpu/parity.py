@@ -88,8 +88,11 @@ def torch_fedavg(
         if m == n_clients:
             ids = np.arange(n_clients)
         else:
-            np.random.seed(r)
-            ids = np.sort(np.random.choice(range(n_clients), m, replace=False))
+            # local RandomState(r) draws the bit-identical ids the
+            # reference's np.random.seed(r) global path draws (same MT19937
+            # seeding) without clobbering the process-global numpy RNG
+            rs = np.random.RandomState(r)
+            ids = np.sort(rs.choice(range(n_clients), m, replace=False))
         w_locals = []
         for cid in ids:
             k = int(counts[cid])

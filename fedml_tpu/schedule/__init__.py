@@ -68,6 +68,110 @@ class RuntimeEstimator:
         a, b = params[worker]
         return a * num_samples + b
 
+    def predict_client(self, worker: int, client: int, num_samples: int,
+                       params: dict[int, tuple]) -> float:
+        """Per-client cost: the client's own empirical mean runtime when
+        history exists (the reference keeps the full per-(worker, client)
+        table for exactly this — runtime_estimate.py's fit is the FALLBACK
+        for unseen clients, not a replacement for observations), else the
+        worker's linear fit at `num_samples`."""
+        times = self.history.get(worker, {}).get(client)
+        if times:
+            return float(np.mean(times))
+        return self.predict(worker, num_samples, params)
+
+
+class CostModel:
+    """Wall-time-driven LPT costs — the Parrot scheduling loop as a host
+    helper (reference: FedAVGAggregator.py:126-187 — uniform schedule for
+    the first rounds while runtimes are recorded, then runtime-fit
+    rebalancing once the fit is trustworthy).
+
+    The simulator records dispatch wall times (`record_dispatch` attributes
+    a dispatch's duration equally across its clients — the dispatch is the
+    smallest observable unit of an XLA round program; per-client resolution
+    sharpens as `cohort_chunk` shrinks). Once at least `fit_after_rounds`
+    dispatches are recorded AND the runtime~samples fit's mean relative
+    error is <= `error_threshold`, `engaged()` flips and `predict_costs`
+    supplies predicted per-client runtimes for `balanced_lpt` /
+    `balanced_lpt_block` in place of raw sample counts.
+    """
+
+    def __init__(self, data_sizes: dict[int, int],
+                 fit_after_rounds: int = 3,
+                 error_threshold: float = 0.5):
+        self.data_sizes = {int(k): int(v) for k, v in data_sizes.items()}
+        self.fit_after_rounds = int(fit_after_rounds)
+        self.error_threshold = float(error_threshold)
+        self.estimator = RuntimeEstimator(num_workers=1)
+        self.rounds_recorded = 0
+        self._fit: tuple | None = None     # (params, error) cache
+
+    def record_dispatch(self, clients, duration_s: float) -> None:
+        """The simulator's wall-time recording hook: one dispatch (round or
+        chunk) covering `clients` took `duration_s` seconds."""
+        clients = [int(c) for c in clients]
+        if not clients or duration_s <= 0.0:
+            return
+        per = float(duration_s) / len(clients)
+        hist = self.estimator.history[0]
+        for c in clients:
+            self.estimator.record(0, c, per)
+            h = hist[c]
+            if len(h) > 64:    # bound per-client history: a 10k-client,
+                del h[:-32]    # 10k-round run must not grow without limit
+        self.rounds_recorded += 1
+        self._fit = None
+        from ..utils import metrics as _mx
+
+        _mx.inc("fed.cost_model.dispatches")
+
+    def _fitted(self) -> tuple:
+        if self._fit is None:
+            params, errors = self.estimator.fit(self.data_sizes,
+                                                uniform_workers=True)
+            self._fit = (params, float(errors[0]))
+            from ..utils import metrics as _mx
+
+            err = self._fit[1]
+            _mx.set_gauge("fed.cost_model.fit_error",
+                          err if np.isfinite(err) else -1.0)
+        return self._fit
+
+    def engaged(self) -> bool:
+        """True once enough dispatches are recorded AND the fit error has
+        dropped below the threshold — the activation rule of the issue's
+        acceptance bar (never engage on a model that can't explain the
+        observations; fall back to size-LPT instead). The fit (and its
+        fed.cost_model.* gauges) refreshes on every call, including during
+        warm-up, so `top`/`/metrics` show the warming state too."""
+        _, err = self._fitted()
+        on = bool(self.rounds_recorded >= self.fit_after_rounds
+                  and np.isfinite(err) and err <= self.error_threshold)
+        from ..utils import metrics as _mx
+
+        _mx.set_gauge("fed.cost_model.engaged", 1.0 if on else 0.0)
+        return on
+
+    def predict_costs(self, clients) -> np.ndarray:
+        """Predicted per-client runtimes for an id row (empirical per-client
+        means where observed, linear-fit extrapolation elsewhere)."""
+        params, _ = self._fitted()
+        return np.asarray([
+            self.estimator.predict_client(
+                0, int(c), self.data_sizes.get(int(c), 0), params)
+            for c in clients
+        ], float)
+
+    @classmethod
+    def from_config(cls, spec, data_sizes: dict[int, int]):
+        """train_args.extra.cost_model: true or {fit_after_rounds,
+        error_threshold} (validated at config load). None/false -> None."""
+        if spec in (None, False):
+            return None
+        opts = dict(spec) if isinstance(spec, dict) else {}
+        return cls(data_sizes, **opts)
+
 
 def lpt_schedule(costs: np.ndarray, num_workers: int,
                  speeds: np.ndarray | None = None) -> list[list[int]]:

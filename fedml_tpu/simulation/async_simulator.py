@@ -65,6 +65,15 @@ class AsyncSimulator:
                  model=None):
         self.cfg = cfg
         t = cfg.train_args
+        # cohort chunking/streaming are SYNC-simulator features (the async
+        # loop trains one client per event; there is no stacked cohort to
+        # chunk) — refuse rather than silently ignore the knobs
+        for knob in ("cohort_chunk", "ingest_prefetch"):
+            if t.extra.get(knob) is not None:
+                raise ValueError(
+                    f"train_args.{knob} has no effect on the async "
+                    "simulator (its event loop dispatches one client at a "
+                    "time); remove it or run the sync simulator")
         self.dataset = dataset if dataset is not None else data_loader.load(cfg)
         self.model = model if model is not None else model_hub.create(
             cfg.model_args.model, self.dataset.num_classes,
@@ -97,6 +106,17 @@ class AsyncSimulator:
         rs = np.random.RandomState(cfg.common_args.random_seed)
         # per-client wall-clock per unit of work (lognormal heterogeneity)
         self.client_time = rs.lognormal(0.0, spread, self.dataset.num_clients)
+        # Parrot cost model (ISSUE 8): the async loop OBSERVES true
+        # per-client completion times (the event queue's whole point), so
+        # it is the sharpest feed for the runtime estimator — each merged
+        # client's duration is recorded per client, not amortized over a
+        # dispatch like the sync simulator's rounds
+        from .. import schedule as lpt_sched
+
+        self.cost_model = lpt_sched.CostModel.from_config(
+            t.extra.get("cost_model"),
+            {i: int(c) for i, c in
+             enumerate(np.asarray(self.dataset.counts))})
 
         self.data = {
             "x": jnp.asarray(self.dataset.x_train),
@@ -171,7 +191,11 @@ class AsyncSimulator:
                     and rs_fault.rand() < spec.client_straggler:
                 dur *= self.straggler_factor
                 _mx.inc("fed.chaos.client_stragglers")
-            heapq.heappush(heap, (now + dur, seq, cid, self.version, self.params))
+            # dur rides the event so the completion can feed the cost model
+            # (ordering is decided by (finish, seq) — the tail never compares)
+            heapq.heappush(heap,
+                           (now + dur, seq, cid, self.version, self.params,
+                            dur))
             seq += 1
 
         for _ in range(min(self.concurrency, total)):
@@ -181,7 +205,7 @@ class AsyncSimulator:
         merged = 0
         with recorder.span("async_run"):
             while merged < total:
-                finish, s, cid, v0, snap = heapq.heappop(heap)
+                finish, s, cid, v0, snap, dur = heapq.heappop(heap)
                 if spec is not None and spec.client_dropout > 0.0 \
                         and rs_fault.rand() < spec.client_dropout:
                     # the client crashed mid-round: its completion never
@@ -206,8 +230,18 @@ class AsyncSimulator:
                 # `fedml_tpu top` and the health flags read
                 record_staleness(tau)
                 record_participation(cid)
+                if self.cost_model is not None:
+                    self.cost_model.record_dispatch([cid], float(dur))
                 _mx.set_gauge("fed.version", float(self.version))
                 if merged % eval_every == 0 or merged == total:
+                    if self.cost_model is not None:
+                        # refresh the fit on eval cadence so the
+                        # fed.cost_model.* gauges (`top`, /metrics) track
+                        # the estimator this loop is feeding; the async
+                        # loop itself has no placement decision to flip —
+                        # the fitted model serves sync-simulator LPT and
+                        # operator introspection
+                        self.cost_model.engaged()
                     row = {
                         "update": merged, "sim_time": finish, "staleness": tau,
                         "train_loss": float(met.loss_sum) / max(float(met.count), 1.0),

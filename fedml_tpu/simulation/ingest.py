@@ -1,0 +1,109 @@
+"""Double-buffered host→device streaming for cohort chunks (ISSUE 8 leg 2).
+
+The chunked round engine (parallel/round.build_chunk_fns) turns the one big
+synchronous `device_put` of a round's stacked cohort into a sequence of
+per-chunk transfers — which would serialize gather→transfer→compute per
+chunk if the host did them inline. This pipeline runs the host side (numpy
+fancy-index gather + `jax.device_put` + block-until-resident) on a worker
+thread, `prefetch` chunks ahead of the consumer, so chunk k+1's transfer
+overlaps chunk k's compute exactly the way the decode engine's
+dispatch-ahead fetches overlap its steps (serving/engine.py).
+
+Observability (`fed.ingest.*`, all surfaced by `report`/`top` and the
+Chrome trace):
+  fed.ingest.chunks       — chunks transferred
+  fed.ingest.bytes        — host bytes shipped to device
+  fed.ingest.prefetched   — chunks already resident when the consumer asked
+                            (the overlap-observed signal the diagnosis
+                            `cohort_sharded_smoke` probe checks)
+  fed.ingest.put_s        — per-chunk gather+transfer latency (histogram)
+  fed.ingest.wait_s       — consumer stall waiting for a chunk (histogram);
+                            ~0 when the pipeline keeps up
+  span "fed.ingest.put"   — one recorder span per transfer (lands on the
+                            Chrome trace, so overlap is visible next to the
+                            round spans)
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator
+
+from ..utils import metrics as mx
+from ..utils.events import recorder
+
+
+class IngestPipeline:
+    """Streams the results of ordered thunks with a bounded prefetch depth.
+
+    Each thunk returns `(payload, nbytes)`: the payload is yielded to the
+    consumer in order; nbytes feeds the byte counter. `prefetch=0` degrades
+    to synchronous inline execution (same metrics, no thread) — the knob the
+    ingest-overhead bench row flips.
+    """
+
+    def __init__(self, prefetch: int = 1):
+        self.prefetch = max(0, int(prefetch))
+
+    def _run(self, thunk: Callable, idx: int):
+        import jax
+
+        t0 = time.perf_counter()
+        with recorder.span("fed.ingest.put", chunk=idx):
+            payload, nbytes = thunk()
+            # the transfer is async; block HERE (worker side) so "resident
+            # before the consumer asks" is real, and the latency honest
+            jax.block_until_ready(payload)
+        mx.observe("fed.ingest.put_s", time.perf_counter() - t0)
+        mx.inc("fed.ingest.chunks")
+        mx.inc("fed.ingest.bytes", int(nbytes))
+        return payload
+
+    def stream(self, thunks: Iterable[Callable]) -> Iterator:
+        """Yield each thunk's payload in order, running up to `prefetch`
+        thunks ahead on a worker thread. A thunk exception re-raises at the
+        consumer's next pull; abandoning the generator stops the worker."""
+        thunks = list(thunks)
+        if self.prefetch == 0:
+            for i, t in enumerate(thunks):
+                yield self._run(t, i)
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def worker():
+            for i, t in enumerate(thunks):
+                if stop.is_set():
+                    return
+                try:
+                    item = ("ok", self._run(t, i))
+                except BaseException as e:  # noqa: BLE001 — relayed below
+                    item = ("err", e)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if item[0] == "err":
+                    return
+
+        th = threading.Thread(target=worker, name="fed-ingest", daemon=True)
+        th.start()
+        try:
+            for _ in range(len(thunks)):
+                try:
+                    kind, item = q.get_nowait()
+                    # already resident: the transfer fully overlapped compute
+                    mx.inc("fed.ingest.prefetched")
+                except queue.Empty:
+                    t0 = time.perf_counter()
+                    kind, item = q.get()
+                    mx.observe("fed.ingest.wait_s",
+                               time.perf_counter() - t0)
+                if kind == "err":
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -184,7 +184,48 @@ class Simulator:
             client_straggler=(self.fault_spec.client_straggler
                               if self.fault_spec else 0.0),
         )
-        self.round_fn = build_round_fn(self.alg, **self._round_kwargs)
+        # ---- Parrot-scale cohort chunking (ISSUE 8): when cohort_chunk is
+        # set, an m-client round streams through HBM-bounded chunk programs
+        # (parallel/round.build_chunk_fns) with the partial aggregate riding
+        # a donated carry — m is bounded by host RAM, not device memory.
+        cc = int(t.extra.get("cohort_chunk", 0) or 0)
+        self._cohort_chunk = cc
+        self._ingest_prefetch = int(t.extra.get("ingest_prefetch", 1) or 0)
+        self.chunk_fn = self.finalize_fn = self._make_carry = None
+        if cc:
+            d = self.mesh.devices.size if self.mesh is not None else 1
+            if cc % d:
+                raise ValueError(
+                    f"train_args.cohort_chunk ({cc}) must be a multiple of "
+                    f"the mesh size ({d}): a chunk splits into per-device "
+                    "sub-batches")
+            if group > 1 and (cc // d) % group:
+                # a group that does not divide the per-device chunk would
+                # change the scan's group boundaries vs the single-shot
+                # program — the bitwise guarantee would silently degrade
+                # to float tolerance (README "Scale-out simulation")
+                raise ValueError(
+                    f"train_args.clients_per_device_parallel ({group}) "
+                    f"must divide the per-device chunk "
+                    f"(cohort_chunk/mesh = {cc // d}): unaligned client "
+                    "groups break chunked == single-shot bit-identity")
+            if self._health_enabled:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "cohort_chunk=%d: in-jit per-client health stats do not "
+                    "ride chunked rounds (cosine-to-aggregate needs the "
+                    "full update stack); participation/straggler tracking "
+                    "stays on", cc)
+            self._health_enabled = False
+            self._round_kwargs["health_stats"] = False
+            from ..parallel.round import build_chunk_fns
+
+            self.chunk_fn, self.finalize_fn, self._make_carry = \
+                build_chunk_fns(self.alg, **self._round_kwargs)
+            self.round_fn = None
+        else:
+            self.round_fn = build_round_fn(self.alg, **self._round_kwargs)
         self.block_fn = None   # built lazily on the first blocked dispatch
         self.hook_state = sec_mod.init_pipeline_state(
             self.attacker, self.defender, self.params, t.client_num_per_round
@@ -199,6 +240,17 @@ class Simulator:
             )
         else:
             self.client_states = jnp.zeros((self.dataset.num_clients,))
+        if self._cohort_chunk and self.mesh is not None:
+            # pin replicated layouts up front: the chunk/finalize jit caches
+            # key on input shardings, and uncommitted first-round state
+            # would buy one throwaway compile per program before settling
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            self.server_state = jax.device_put(self.server_state, rep)
+            self.client_states = jax.device_put(self.client_states, rep)
+            if self.hook_state is not None:
+                self.hook_state = jax.device_put(self.hook_state, rep)
 
         raw = {
             "x": self.dataset.x_train,
@@ -209,20 +261,45 @@ class Simulator:
         # fedml_attacker.poison_data hook, client_trainer.py:32-38)
         raw = self.attacker.poison_dataset(raw, self.num_classes)
         counts = np.asarray(self.dataset.counts, np.float32)
-        if self.mesh is not None:
-            # the stacked client axis must divide the mesh; pad with zero-mask
-            # ghost clients (never sampled — sample_clients draws < num_clients)
-            d = self.mesh.devices.size
-            pad = (-raw["x"].shape[0]) % d
-            if pad:
-                raw = {
-                    k: np.concatenate(
-                        [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
-                    ) for k, v in raw.items()
-                }
-                counts = np.concatenate([counts, np.zeros(pad, np.float32)])
-        self.data = shard_fed_data(raw, self.mesh)
+        if self._cohort_chunk:
+            # chunked rounds stream per-chunk cohort slices from HOST
+            # memory (simulation/ingest.py): the full stacked dataset never
+            # lands on device, and ghost-client mesh padding is unnecessary
+            # because only sampled cohorts ever ship
+            self._host_data = {k: np.asarray(v) for k, v in raw.items()}
+            self.data = None
+            from .ingest import IngestPipeline
+
+            self._ingest = IngestPipeline(self._ingest_prefetch)
+        else:
+            self._host_data = None
+            self._ingest = None
+            if self.mesh is not None:
+                # the stacked client axis must divide the mesh; pad with
+                # zero-mask ghost clients (never sampled — sample_clients
+                # draws < num_clients)
+                d = self.mesh.devices.size
+                pad = (-raw["x"].shape[0]) % d
+                if pad:
+                    raw = {
+                        k: np.concatenate(
+                            [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                        ) for k, v in raw.items()
+                    }
+                    counts = np.concatenate([counts, np.zeros(pad, np.float32)])
+            self.data = shard_fed_data(raw, self.mesh)
         self.counts = jnp.asarray(counts)
+        # Parrot cost model (ISSUE 8 leg 3): dispatch wall times feed a
+        # runtime~samples fit; once trustworthy, LPT costs switch from raw
+        # sample counts to predicted runtimes (schedule.CostModel)
+        self._cost_model = lpt_sched.CostModel.from_config(
+            t.extra.get("cost_model"),
+            {i: int(c) for i, c in
+             enumerate(np.asarray(self.dataset.counts))})
+        # the first dispatch's wall time is dominated by the XLA compile
+        # (orders of magnitude above steady state) — recording it would
+        # poison the per-client empirical means and the fit error
+        self._cold_dispatch = True
 
         xb, yb, mb = _pad_test_batches(
             self.dataset.x_test, self.dataset.y_test, max(t.batch_size, 64)
@@ -242,24 +319,29 @@ class Simulator:
                          self.num_classes), "eval_fn")
         self.history: list[dict] = []
 
-    # reference parity: np seeded by round index (fedavg_api.py:127-135)
+    # reference parity: sampling seeded by round index (fedavg_api.py:127-135
+    # does np.random.seed(round_idx); a LOCAL RandomState(round_idx) draws
+    # the bit-identical ids — same MT19937 seeding — without perturbing the
+    # process-global numpy RNG that chaos/async/data code shares)
     def sample_clients(self, round_idx: int) -> np.ndarray:
         t = self.cfg.train_args
         n, m = self.dataset.num_clients, t.client_num_per_round
         if n == m:
             return np.arange(m, dtype=np.int32)
-        np.random.seed(round_idx)
-        return np.sort(np.random.choice(range(n), m, replace=False)).astype(np.int32)
+        rs = np.random.RandomState(round_idx)
+        return np.sort(rs.choice(range(n), m, replace=False)).astype(np.int32)
 
     def _pad_only(self, ids: np.ndarray):
-        """Pad sampled ids to a multiple of the mesh size with zero-weight
-        duplicates so shard_map shapes stay static. Returns
+        """Pad sampled ids to a multiple of the mesh size — of the cohort
+        chunk when chunking, so every chunk program sees full static shapes
+        — with zero-weight duplicates so shard shapes stay static. Returns
         (padded_ids, weights, pad)."""
         weights = np.asarray(self.counts)[ids].astype(np.float32)
-        if self.mesh is None:
+        mult = self._cohort_chunk or (
+            self.mesh.devices.size if self.mesh is not None else 0)
+        if not mult:
             return ids, weights, 0
-        d = self.mesh.devices.size
-        pad = (-len(ids)) % d
+        pad = (-len(ids)) % mult
         if pad:
             # pad with a duplicate of an already-sampled client (weight 0):
             # its recompute is identical, so the client-state scatter-back is a
@@ -279,8 +361,40 @@ class Simulator:
             return False
         d = self.mesh.devices.size
         schedulable = pad == 0 or not self._use_full
+        varied = (len(np.unique(weights)) > 1
+                  or (self._cost_model is not None
+                      and self._cost_model.engaged()))
         return bool(self._schedule and schedulable and len(weights) > d
-                    and len(np.unique(weights)) > 1)
+                    and varied)
+
+    def _sched_costs(self, ids: np.ndarray, weights: np.ndarray) -> np.ndarray:
+        """Per-slot LPT costs for one padded id row: raw sample counts
+        (== weights) until the runtime cost model engages, then predicted
+        per-client runtimes (Parrot's heterogeneity-aware switch —
+        schedule.CostModel). Pad duplicates keep cost 0 so the scheduler
+        never treats them as load."""
+        cm = self._cost_model
+        if cm is None or not cm.engaged():
+            return weights
+        costs = cm.predict_costs(ids)
+        return np.where(weights > 0, costs, 0.0).astype(float)
+
+    def _record_dispatch(self, ids, weights, duration_s: float) -> None:
+        """The wall-time recording hook feeding the cost model: one
+        dispatch covering this id row took duration_s (pad duplicates
+        excluded — their recompute is not schedulable load). The cold
+        dispatch (jit compile riding the wall clock) is dropped."""
+        if self._cost_model is None:
+            return
+        if self._cold_dispatch:
+            return
+        real = np.asarray(ids)[np.asarray(weights) > 0]
+        self._cost_model.record_dispatch(real.tolist(), duration_s)
+        # refresh the fit + fed.cost_model.* gauges every observation, not
+        # only when the mesh scheduler consults engaged(): a mesh-less run
+        # still fits and exports (LPT placement is mesh-only, but the
+        # estimator must be observable wherever it records)
+        self._cost_model.engaged()
 
     def _pad_ids(self, ids: np.ndarray):
         """Pad sampled ids to a multiple of the mesh size with zero-weight
@@ -292,7 +406,8 @@ class Simulator:
         device slots so per-chip useful-sample load is even)."""
         ids, weights, pad = self._pad_only(ids)
         if self._lpt_applies(weights, pad):
-            blocks = lpt_sched.balanced_lpt(weights, self.mesh.devices.size)
+            blocks = lpt_sched.balanced_lpt(self._sched_costs(ids, weights),
+                                            self.mesh.devices.size)
             perm = np.concatenate([np.asarray(b, int) for b in blocks])
             ids, weights = ids[perm], weights[perm]
         return ids, weights
@@ -309,13 +424,17 @@ class Simulator:
         weights = np.stack([w for _, w, _ in trips])
         rows = np.flatnonzero([self._lpt_applies(w, p) for _, w, p in trips])
         if rows.size:
+            costs = np.stack([self._sched_costs(ids[i], weights[i])
+                              for i in rows])
             perms = lpt_sched.balanced_lpt_block(
-                weights[rows], self.mesh.devices.size)
+                costs, self.mesh.devices.size)
             ids[rows] = np.take_along_axis(ids[rows], perms, axis=1)
             weights[rows] = np.take_along_axis(weights[rows], perms, axis=1)
         return ids, weights
 
     def run_round(self, round_idx: int) -> dict:
+        if self._cohort_chunk:
+            return self._run_round_chunked(round_idx)
         ids, weights = self._pad_ids(self.sample_clients(round_idx))
         rng = jax.random.fold_in(
             jax.random.key(self.cfg.common_args.random_seed), round_idx
@@ -335,9 +454,89 @@ class Simulator:
         self.server_state = out.server_state
         self.client_states = out.client_states
         self.hook_state = out.hook_state
+        dur = time.perf_counter() - t0
         self.health.observe_round(round_idx, ids, weights, health,
-                                  duration_s=time.perf_counter() - t0,
-                                  faults=faults)
+                                  duration_s=dur, faults=faults)
+        self._record_dispatch(ids, weights, dur)
+        self._cold_dispatch = False
+        self.dp.step_round()
+        if self.dp.enabled and self.dp.accountant is not None:
+            metrics["dp_epsilon"] = self.dp.get_epsilon()
+        return metrics
+
+    # ------------------------------------------- chunked cohort execution
+    def _chunk_plan(self, ids: np.ndarray, weights: np.ndarray):
+        """Split the padded, scheduled [m] id row into per-device/per-chunk
+        sub-batches: chunk j takes rows [k*m_d + j*c, ..+c) of every device
+        block k, so each device walks ITS schedule slice in order and the
+        per-device accumulation order matches the single-shot program —
+        the bit-identity invariant (parallel/round.chunk_body)."""
+        m = len(ids)
+        d = self.mesh.devices.size if self.mesh is not None else 1
+        c = self._cohort_chunk // d
+        m_d = m // d
+        plan = []
+        for j in range(m // self._cohort_chunk):
+            rows = np.concatenate([
+                np.arange(k * m_d + j * c, k * m_d + (j + 1) * c)
+                for k in range(d)])
+            plan.append((j, ids[rows], weights[rows]))
+        return plan, c
+
+    def _chunk_thunk(self, cids: np.ndarray, cw: np.ndarray):
+        """One ingest unit: host-gather the chunk's client rows, ship them
+        client-sharded. Runs on the ingest pipeline's worker thread."""
+        def put():
+            chunk = {k: v[cids] for k, v in self._host_data.items()}
+            nbytes = sum(a.nbytes for a in chunk.values())
+            dev = (shard_fed_data(chunk, self.mesh),
+                   jnp.asarray(cids), jnp.asarray(cw))
+            return dev, nbytes
+        return put
+
+    def _dispatch_chunked(self, round_idx: int):
+        """Dispatch one chunk-streamed round — nothing here blocks on the
+        device: chunk k+1's gather+transfer overlaps chunk k's compute
+        (IngestPipeline), the partial aggregate rides the donated carry,
+        and finalize closes the round. Returns (ids, weights, RoundOutput)."""
+        ids, weights = self._pad_ids(self.sample_clients(round_idx))
+        rng = jax.random.fold_in(
+            jax.random.key(self.cfg.common_args.random_seed), round_idx)
+        plan, c_local = self._chunk_plan(ids, weights)
+        chunk_struct = {
+            k: jax.ShapeDtypeStruct((len(plan[0][1]),) + v.shape[1:], v.dtype)
+            for k, v in self._host_data.items()}
+        carry = self._make_carry(self.server_state, self.client_states,
+                                 ids, chunk_struct)
+        thunks = [self._chunk_thunk(cids, cw) for _, cids, cw in plan]
+        for (j, _, _), (cdata, cids_dev, cw_dev) in zip(
+                plan, self._ingest.stream(thunks)):
+            carry = self.chunk_fn(
+                carry, self.server_state, cdata, cids_dev, cw_dev, rng,
+                jnp.asarray(j * c_local, jnp.int32))
+        out = self.finalize_fn(
+            self.server_state, carry, jnp.asarray(ids),
+            jnp.asarray(weights), rng, self.hook_state)
+        self.server_state = out.server_state
+        self.client_states = out.client_states
+        self.hook_state = out.hook_state
+        return ids, weights, out
+
+    def _run_round_chunked(self, round_idx: int) -> dict:
+        t0 = time.perf_counter()
+        with recorder.span("train", round=round_idx) as sp:
+            ids, weights, out = self._dispatch_chunked(round_idx)
+            sp.meta["chunks"] = len(ids) // self._cohort_chunk
+            fetched = jax.device_get(out.metrics)
+        faults = fetched.pop("faults", None)
+        metrics = jax.tree.map(float, fetched)
+        dur = time.perf_counter() - t0
+        # chunked rounds run the in-jit health stats off (see __init__);
+        # participation/straggler accounting still observes every round
+        self.health.observe_round(round_idx, ids, weights, None,
+                                  duration_s=dur, faults=faults)
+        self._record_dispatch(ids, weights, dur)
+        self._cold_dispatch = False
         self.dp.step_round()
         if self.dp.enabled and self.dp.accountant is not None:
             metrics["dp_epsilon"] = self.dp.get_epsilon()
@@ -441,18 +640,34 @@ class Simulator:
         """Enqueue one K-round block program plus whatever must read its
         output params (eval, artifact snapshot) BEFORE the next dispatch
         donates them. Nothing here blocks on the device."""
-        if self.block_fn is None:
-            self.block_fn = build_block_fn(self.alg, **self._round_kwargs)
-        ids, weights = self._schedule_block(blk)
-        t0 = time.perf_counter()
-        out = self.block_fn(
-            self.server_state, self.client_states, self.data,
-            jnp.asarray(ids), jnp.asarray(weights), base_rng,
-            jnp.asarray(blk, dtype=jnp.int32), self.hook_state,
-        )
-        self.server_state = out.server_state
-        self.client_states = out.client_states
-        self.hook_state = out.hook_state
+        if self._cohort_chunk:
+            # chunked + blocked: every round in the block streams its chunk
+            # programs (all async-dispatched — the carry chain and donation
+            # keep the device busy) and the block defers ALL metric fetches
+            # to drain time. Same programs, same keys as per-round chunked
+            # mode, so blocked == per-round stays bit-identical.
+            t0 = time.perf_counter()
+            ids_l, w_l, mets = [], [], []
+            for r in blk:
+                ids_r, w_r, out_r = self._dispatch_chunked(r)
+                ids_l.append(ids_r)
+                w_l.append(w_r)
+                mets.append(out_r.metrics)
+            ids, weights, metrics = np.stack(ids_l), np.stack(w_l), mets
+        else:
+            if self.block_fn is None:
+                self.block_fn = build_block_fn(self.alg, **self._round_kwargs)
+            ids, weights = self._schedule_block(blk)
+            t0 = time.perf_counter()
+            out = self.block_fn(
+                self.server_state, self.client_states, self.data,
+                jnp.asarray(ids), jnp.asarray(weights), base_rng,
+                jnp.asarray(blk, dtype=jnp.int32), self.hook_state,
+            )
+            self.server_state = out.server_state
+            self.client_states = out.client_states
+            self.hook_state = out.hook_state
+            metrics = out.metrics
         eval_out = (self._eval_dispatch()
                     if self._eval_due(blk[-1], rounds) else None)
         # per-round publishes degrade to one per block in blocked mode
@@ -460,9 +675,9 @@ class Simulator:
         # next block's donation can't free the buffers under the store
         from .. import mlops
 
-        snap = (jax.tree.map(jnp.copy, out.server_state.params)
+        snap = (jax.tree.map(jnp.copy, self.server_state.params)
                 if mlops.artifact_store() is not None else None)
-        return (blk, ids, weights, out.metrics, eval_out, snap, t0)
+        return (blk, ids, weights, metrics, eval_out, snap, t0)
 
     def _drain_block(self, pending) -> None:
         """Materialize one dispatched block: ONE host transfer for the
@@ -473,7 +688,13 @@ class Simulator:
         returns in microseconds, so timing the dispatch alone would report
         near-zero per-round durations to the sinks."""
         blk, ids, weights, metrics, eval_out, snap, t0 = pending
-        m = jax.device_get(metrics)
+        if isinstance(metrics, list):
+            # chunked dispatch returns one metrics pytree PER ROUND; stack
+            # them into the same [K]-leading layout the block program emits
+            fetched = [jax.device_get(x) for x in metrics]
+            m = jax.tree.map(lambda *xs: np.stack(xs), *fetched)
+        else:
+            m = jax.device_get(metrics)
         block_s = time.perf_counter() - t0
         # stacked [K, m] health arrays rode the block's single transfer;
         # peel them off before the scalar rows are built, then feed the
@@ -492,6 +713,8 @@ class Simulator:
             self.health.observe_round(
                 r, ids[j], weights[j], h_j,
                 duration_s=block_s / max(len(blk), 1), faults=f_j)
+            self._record_dispatch(ids[j], weights[j],
+                                  block_s / max(len(blk), 1))
             self.dp.step_round()
             if self.dp.enabled and self.dp.accountant is not None:
                 row["dp_epsilon"] = self.dp.get_epsilon()
@@ -506,6 +729,9 @@ class Simulator:
                                         time.perf_counter() - te)
             recorder.log(row)
             self.history.append(row)
+        # the whole first block rode the compile: only after it drains do
+        # dispatch times become steady-state observations
+        self._cold_dispatch = False
         if snap is not None:
             self._publish_model(blk[-1], snap)
 

@@ -103,5 +103,9 @@ def test_profile_and_diagnosis(registry):
     prof = api.fedml_login("k-123")
     assert prof["mode"] == "local" and os.path.exists(api._PROFILE)
     assert api.logout() and not os.path.exists(api._PROFILE)
-    rep = api.fedml_diagnosis()
+    # subset probes: the API contract is exercised without paying the full
+    # ~30s battery a second time in tier-1 (test_cli_platform runs it once)
+    rep = api.fedml_diagnosis(only=["jax", "wire_codec",
+                                    "loopback_transport"])
     assert rep["checks"]["loopback_transport"]["ok"]
+    assert "chaos_smoke" not in rep["checks"]

@@ -1,14 +1,22 @@
 """KV-cache decode (llm/decode.py) parity vs the full-recompute forward:
 prefill+step must reproduce the module's logits exactly-ish, and greedy
 generation must emit the identical token sequence, for f32 and int8 bases,
-with and without LoRA adapters."""
+with and without LoRA adapters.
+
+Tier-1 budget: the shared model/params/reference builds are memoized at
+module scope and the jitted generate closures are shared across tests
+(every test was paying its own XLA compiles of the identical programs —
+the PR 7 module-fixture discipline, see memory/tier1-run-recipe); every
+assertion is unchanged."""
+import functools
+
 import jax
 import pytest
 import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.llm.decode import (
-    make_greedy_generate, make_kv_decode, stack_blocks,
+    make_generate, make_greedy_generate, make_kv_decode, stack_blocks,
 )
 from fedml_tpu.llm.lora import lora_init
 from fedml_tpu.llm.quant import make_inscan_quant_apply, quantize_tree_int8
@@ -18,7 +26,10 @@ V, D, L, H, FF, TP = 96, 64, 3, 4, 128, 10   # TP = prompt length
 MAXLEN = 24
 
 
+@functools.lru_cache(maxsize=None)
 def _setup(quant=False, adapters=False):
+    """Deterministic (seeded) per-config fixtures, built once per module —
+    tests treat every returned tree as read-only."""
     model = TransformerLM(vocab_size=V, d_model=D, n_layers=L, n_heads=H,
                           d_ff=FF, scan_layers=True)
     base = model.init(jax.random.key(0),
@@ -41,14 +52,36 @@ def _setup(quant=False, adapters=False):
     return model, params, ads, ref_apply, ref_ads, toks
 
 
+# one jitted program per (closure, shape) shared by every test — the
+# greedy/sampling generate closures are pure functions of H
+@functools.lru_cache(maxsize=None)
+def _jit_greedy():
+    return jax.jit(make_greedy_generate(H), static_argnums=(3, 4))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_generate(sample=False):
+    return jax.jit(make_generate(H, sample=sample), static_argnums=(3, 4))
+
+
+_REF_JIT: dict = {}
+
+
 def _ref_greedy(ref_apply, params, ref_ads, toks, n_new):
-    buf = np.asarray(toks)
+    """Greedy reference loop over the recompute forward. The buffer is
+    padded to its FINAL length up front so ONE compiled forward serves all
+    n_new steps (the model is causal: tokens after position p cannot
+    change the logits at p, so the trailing zeros are inert)."""
+    tp = toks.shape[1]
+    buf = np.zeros((1, tp + n_new), np.int32)
+    buf[:, :tp] = np.asarray(toks)
+    japply = _REF_JIT.setdefault(id(ref_apply), jax.jit(ref_apply))
     out = []
-    for _ in range(n_new):
-        logits = ref_apply(params, ref_ads, jnp.asarray(buf))
-        nxt = int(jnp.argmax(logits[0, buf.shape[1] - 1]))
+    for i in range(n_new):
+        logits = japply(params, ref_ads, jnp.asarray(buf))
+        nxt = int(jnp.argmax(logits[0, tp + i - 1]))
         out.append(nxt)
-        buf = np.concatenate([buf, [[nxt]]], axis=1)
+        buf[0, tp + i] = nxt
     return out
 
 
@@ -74,10 +107,8 @@ def test_prefill_and_step_match_full_forward():
 def test_greedy_generate_matches_recompute_sequences():
     for quant, ads_on in ((False, False), (False, True), (True, True)):
         model, params, ads, ref_apply, ref_ads, toks = _setup(quant, ads_on)
-        gen = make_greedy_generate(H)
         n_new = 8
-        got = jax.jit(gen, static_argnums=(3, 4))(
-            params, ads, toks, MAXLEN, n_new)
+        got = _jit_greedy()(params, ads, toks, MAXLEN, n_new)
         want = _ref_greedy(ref_apply, params, ref_ads, toks, n_new)
         assert np.asarray(got).tolist() == want, (quant, ads_on)
 
@@ -108,14 +139,12 @@ def test_generate_with_padded_prompt_and_traced_length():
     bucket with the real length traced must emit the same sequence as the
     exact-shape path (padded K/V entries are masked until overwritten)."""
     _model, params, ads, ref_apply, ref_ads, toks = _setup(True, True)
-    gen = make_greedy_generate(H)
+    gen = _jit_greedy()
     n_new = 6
-    want = np.asarray(jax.jit(gen, static_argnums=(3, 4))(
-        params, ads, toks, MAXLEN, n_new)).tolist()
+    want = np.asarray(gen(params, ads, toks, MAXLEN, n_new)).tolist()
     pbucket = 16                                  # TP=10 padded up
     padded = jnp.zeros((1, pbucket), jnp.int32).at[:, :TP].set(toks)
-    got = jax.jit(gen, static_argnums=(3, 4))(
-        params, ads, padded, MAXLEN, n_new, length=jnp.int32(TP))
+    got = gen(params, ads, padded, MAXLEN, n_new, length=jnp.int32(TP))
     assert np.asarray(got).tolist() == want
 
 
@@ -163,8 +192,7 @@ def test_generate_single_token_costs_prefill_only():
     """max_new_tokens=1: the first token comes from prefill; the scan runs
     zero decode steps (a trailing wasted step was review-flagged)."""
     _model, params, ads, ref_apply, ref_ads, toks = _setup(False, False)
-    gen = make_greedy_generate(H)
-    got = jax.jit(gen, static_argnums=(3, 4))(params, ads, toks, MAXLEN, 1)
+    got = _jit_greedy()(params, ads, toks, MAXLEN, 1)
     want = _ref_greedy(ref_apply, params, ref_ads, toks, 1)
     assert np.asarray(got).tolist() == want
 
@@ -236,8 +264,7 @@ def test_sampling_decode_temperature_and_topk():
     from fedml_tpu.serving.predictor import GreedyLMPredictor
 
     model, params, ads, ref_apply, ref_ads, toks = _setup(False, False)
-    greedy = jax.jit(make_greedy_generate(H), static_argnums=(3, 4))(
-        params, ads, toks, MAXLEN, 8)
+    greedy = _jit_greedy()(params, ads, toks, MAXLEN, 8)
 
     top1 = make_generate(H, sample=True, top_k=1)
     got = jax.jit(top1, static_argnums=(3, 4))(
@@ -245,7 +272,7 @@ def test_sampling_decode_temperature_and_topk():
         temperature=jnp.float32(5.0))
     assert np.asarray(got).tolist() == np.asarray(greedy).tolist()
 
-    samp = jax.jit(make_generate(H, sample=True), static_argnums=(3, 4))
+    samp = _jit_generate(True)
     cold = samp(params, ads, toks, MAXLEN, 8, rng=jax.random.key(1),
                 temperature=jnp.float32(1e-4))
     assert np.asarray(cold).tolist() == np.asarray(greedy).tolist()
@@ -312,7 +339,7 @@ def test_prefill_with_flash_attention_matches_dense():
     from fedml_tpu.ops.flash_attention import flash_attn_fn
 
     _m, params, ads, _ra, _rads, toks = _setup(False, False)
-    dense_gen = jax.jit(make_generate(H), static_argnums=(3, 4))
+    dense_gen = _jit_generate(False)
     flash_gen = jax.jit(make_generate(H, prefill_attn_fn=flash_attn_fn),
                         static_argnums=(3, 4))
     want = np.asarray(dense_gen(params, ads, toks, MAXLEN, 6)).tolist()
@@ -353,8 +380,7 @@ def test_batched_decode_matches_per_row_generation():
     rs = np.random.RandomState(3)
     rows = [rs.randint(1, V, n).tolist() for n in (6, 10, 8)]
     n_new = 5
-    gen = make_generate(H)
-    jgen = jax.jit(gen, static_argnums=(3, 4))
+    jgen = _jit_generate(False)
 
     want = []
     for r in rows:
@@ -384,7 +410,7 @@ def test_batched_sampling_matches_per_row_generation():
     rows = [rs.randint(1, V, n).tolist() for n in (6, 10, 8)]
     n_new = 5
     temp = jnp.float32(1.5)
-    jgen = jax.jit(make_generate(H, sample=True), static_argnums=(3, 4))
+    jgen = _jit_generate(True)
     keys = jax.random.split(jax.random.key(42), len(rows))
 
     want = []

@@ -3,8 +3,8 @@ import numpy as np
 import pytest
 
 from fedml_tpu.schedule import (
-    RuntimeEstimator, dp_schedule, generate_client_schedule, linear_fit,
-    lpt_schedule,
+    CostModel, RuntimeEstimator, dp_schedule, generate_client_schedule,
+    linear_fit, lpt_schedule,
 )
 
 
@@ -58,6 +58,92 @@ def test_uniform_schedule_before_fit():
     sched = generate_client_schedule(list(range(7)), {c: 1 for c in range(7)},
                                      3, None, round_idx=0)
     assert sum(len(s) for s in sched) == 7
+
+
+def test_estimator_fit_predict_golden():
+    """Exact fit/predict values on noiseless linear observations: the fit
+    recovers (a, b) to float precision and predict is a*n+b."""
+    est = RuntimeEstimator(num_workers=1)
+    sizes = {c: 8 * (c + 1) for c in range(5)}
+    for c in range(5):
+        est.record(0, c, 0.25 * sizes[c] + 2.0)
+    params, errors = est.fit(sizes)
+    a, b = params[0]
+    assert abs(a - 0.25) < 1e-9 and abs(b - 2.0) < 1e-8
+    assert errors[0] < 1e-9
+    assert abs(est.predict(0, 100, params) - 27.0) < 1e-6
+
+
+def test_estimator_mean_fallback_under_two_points():
+    """len(xs) < 2 (or a single distinct size) falls back to (0, mean)
+    with infinite error — the guard that keeps the cost model from
+    engaging on one observation."""
+    est = RuntimeEstimator(num_workers=1)
+    params, errors = est.fit({0: 10})
+    assert params[0] == (0.0, 1.0) and errors[0] == float("inf")
+    est.record(0, 0, 3.0)
+    params, errors = est.fit({0: 10})
+    assert params[0] == (0.0, 3.0) and errors[0] == float("inf")
+    # two observations of the SAME size still can't support a slope
+    est.record(0, 0, 5.0)
+    params, errors = est.fit({0: 10})
+    assert params[0] == (0.0, 4.0) and errors[0] == float("inf")
+
+
+def test_estimator_predict_client_prefers_history():
+    """Per-client empirical mean beats the fit where history exists; the
+    fit covers unseen clients."""
+    est = RuntimeEstimator(num_workers=1)
+    sizes = {c: 10 * (c + 1) for c in range(4)}
+    for c in range(3):
+        est.record(0, c, 0.1 * sizes[c])
+    params, _ = est.fit(sizes)
+    est.record(0, 1, 99.0)     # client 1 turns out to be a phone
+    assert abs(est.predict_client(0, 1, sizes[1], params)
+               - np.mean([2.0, 99.0])) < 1e-9
+    # client 3 never observed -> linear fit at its size
+    assert abs(est.predict_client(0, 3, sizes[3], params)
+               - est.predict(0, sizes[3], params)) < 1e-9
+
+
+def test_cost_model_gating_and_schedule_flip():
+    """Seeded fake durations: the model refuses to engage before
+    fit_after_rounds or above the error threshold, then engages and flips
+    the balanced-LPT permutation away from the size-based one."""
+    from fedml_tpu.schedule import balanced_lpt
+
+    rs = np.random.RandomState(11)
+    m = 16
+    sizes = {c: int(s) for c, s in enumerate(rs.randint(8, 64, m))}
+    speeds = np.where(np.arange(m) % 4 == 0, 6.0, 1.0)   # every 4th: phone
+    true_t = {c: speeds[c] * sizes[c] for c in range(m)}
+    cm = CostModel(sizes, fit_after_rounds=3, error_threshold=0.8)
+    cm.record_dispatch(range(m), sum(true_t.values()))
+    cm.record_dispatch(range(m), sum(true_t.values()))
+    assert not cm.engaged()          # below fit_after_rounds
+    for c in range(m):               # per-client observations arrive
+        cm.record_dispatch([c], true_t[c])
+    assert cm.rounds_recorded >= 3
+    # past fit_after_rounds the THRESHOLD decides, nothing else
+    assert cm.engaged() == (cm._fitted()[1] <= cm.error_threshold)
+    cm2 = CostModel(sizes, fit_after_rounds=1, error_threshold=1e-12)
+    for c in range(m):               # runtimes uncorrelated with size
+        cm2.record_dispatch([c], float(rs.rand() * 50 + 1))
+    assert not cm2.engaged()         # fit can't explain -> stays off
+    cm3 = CostModel(sizes, fit_after_rounds=1, error_threshold=10.0)
+    for _ in range(2):
+        for c in range(m):
+            cm3.record_dispatch([c], true_t[c])
+    assert cm3.engaged()
+    pred = cm3.predict_costs(range(m))
+    # empirical means reproduce the true per-client runtimes exactly
+    np.testing.assert_allclose(pred, [true_t[c] for c in range(m)])
+    size_row = np.asarray([sizes[c] for c in range(m)], float)
+    s_size = balanced_lpt(size_row, 4)
+    s_cost = balanced_lpt(pred, 4)
+    assert s_size != s_cost, "predicted runtimes did not flip the schedule"
+    makespan = lambda sch: max(sum(true_t[j] for j in grp) for grp in sch)
+    assert makespan(s_cost) < makespan(s_size)
 
 
 def test_balanced_lpt_equal_slots_and_better_makespan():

@@ -1,0 +1,310 @@
+"""Parrot-scale cohorts (ISSUE 8): chunked, client-sharded, streamed rounds.
+
+The chunked engine (parallel/round.build_chunk_fns + the simulator's
+cohort_chunk driver) must be BITWISE indistinguishable — history, final
+params, client states, DP epsilon — from the single-shot round program on
+all three aggregation paths (LINEAR no-mesh, LINEAR shard_map, FULL),
+per-round and blocked, while streaming chunk data from host memory through
+the double-buffered ingest pipeline. Program count must stay bounded
+(one chunk program + one finalize program)."""
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.simulation.simulator import Simulator
+
+
+def _cfg(backend="sp", extra=None, sec=None, opt="FedAvg", m=16, n=16,
+         dp=None, rounds=5, seed=0):
+    d = {
+        "common_args": {"training_type": "simulation", "random_seed": seed},
+        "data_args": {"dataset": "synthetic", "partition_method": "hetero",
+                      "partition_alpha": 0.3,
+                      "extra": {"synthetic_samples_per_client": 16}},
+        "model_args": {"model": "lr"},
+        "train_args": {
+            "federated_optimizer": opt,
+            "client_num_in_total": n, "client_num_per_round": m,
+            "comm_round": rounds, "epochs": 1, "batch_size": 8,
+            "learning_rate": 0.1, "extra": extra or {},
+        },
+        "validation_args": {"frequency_of_the_test": 0},
+        "comm_args": {"backend": backend},
+    }
+    if sec:
+        d["security_args"] = sec
+    if dp:
+        d["dp_args"] = dp
+    return fedml_tpu.init(config=d)
+
+
+def _assert_bitwise(ref, chk):
+    """Histories exactly equal (float ==, incl. dp_epsilon when present)
+    and params/client_states bitwise identical."""
+    assert ref.history == chk.history, "history diverged"
+    for a, b in zip(
+            jax.tree.leaves(jax.device_get(ref.server_state.params)),
+            jax.tree.leaves(jax.device_get(chk.server_state.params))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(ref.client_states)),
+                    jax.tree.leaves(jax.device_get(chk.client_states))):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.fixture(scope="module")
+def sp_pair():
+    """Single-shot vs chunked on the no-mesh LINEAR path, with the cohort
+    8x the per-chip chunk (16 clients, chunk 2). Ingest metric deltas and
+    span names are captured HERE because the per-test metrics-registry swap
+    (conftest) happens after module fixtures run."""
+    from fedml_tpu.utils import metrics as mx
+    from fedml_tpu.utils.events import recorder
+
+    ref = Simulator(_cfg(rounds=4))
+    ref.run()
+    before = mx.snapshot()["counters"]
+    chk = Simulator(_cfg(rounds=4,
+                         extra={"cohort_chunk": 2, "ingest_prefetch": 1}))
+    chk.run()
+    after = mx.snapshot()["counters"]
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in ("fed.ingest.chunks", "fed.ingest.bytes",
+                       "fed.ingest.prefetched")}
+    span_names = {s.name for s in recorder.spans}
+    return ref, chk, delta, span_names
+
+
+def test_chunked_bitwise_identical_sp(sp_pair):
+    """Acceptance pin: a cohort 8x the per-chip chunk size runs chunked
+    bit-identically to the single-shot program (LINEAR, no mesh)."""
+    ref, chk, _, _ = sp_pair
+    assert chk._cohort_chunk == 2 and len(ref.history) == 4
+    # 16-client cohort / 2-client chunk = 8 chunks per round: >= 8x pin
+    assert 16 // chk._cohort_chunk >= 8
+    _assert_bitwise(ref, chk)
+
+
+def test_ingest_streams_and_overlaps(sp_pair):
+    """The chunk data really streams through the ingest pipeline — chunk
+    count, bytes, and at least one prefetch-overlap observed — and the
+    `fed.ingest.put` spans land on the Chrome trace."""
+    _, _, delta, span_names = sp_pair
+    assert delta["fed.ingest.chunks"] == 4 * 8      # 4 rounds x 8 chunks
+    assert delta["fed.ingest.bytes"] > 0
+    assert delta["fed.ingest.prefetched"] >= 1
+    assert "fed.ingest.put" in span_names
+    import json
+
+    from fedml_tpu.utils.events import recorder
+
+    out = recorder.export_chrome_trace("/tmp/_sim_scale_trace.json")
+    with open(out) as f:
+        names = {e.get("name") for e in json.load(f)["traceEvents"]}
+    assert "fed.ingest.put" in names
+
+
+def test_chunked_program_count_bounded(sp_pair):
+    """Retrace guard: a multi-round chunked run compiles ONE chunk program
+    and ONE finalize program."""
+    _, chk, _, _ = sp_pair
+    assert chk.chunk_fn._fn._cache_size() == 1
+    assert chk.finalize_fn._fn._cache_size() == 1
+
+
+def test_chunked_bitwise_identical_mesh_scaffold():
+    """LINEAR shard_map path on the 8-device mesh with stateful clients
+    (SCAFFOLD control variates scatter back through chunked rounds): the
+    per-device/per-chunk sub-batch layout must reproduce the single-shot
+    client->device assignment bit-for-bit."""
+    over = dict(backend="xla", opt="SCAFFOLD", m=16, n=32, rounds=3)
+    ref = Simulator(_cfg(**over))
+    assert ref.mesh is not None and ref.mesh.devices.size == 8
+    ref.run()
+    chk = Simulator(_cfg(extra={"cohort_chunk": 8}, **over))
+    chk.run()
+    _assert_bitwise(ref, chk)
+
+
+def test_chunked_bitwise_identical_full_defense():
+    """FULL aggregation path (krum needs every update materialized): the
+    chunked carry's stacked update buffer must hand the hook the exact
+    array the single-shot program stacks."""
+    sec = {"enable_defense": True, "defense_type": "krum",
+           "byzantine_client_num": 2}
+    over = dict(backend="sp", sec=sec, m=8, n=8, rounds=2)
+    ref = Simulator(_cfg(**over))
+    assert ref._use_full
+    ref.run()
+    chk = Simulator(_cfg(extra={"cohort_chunk": 2}, **over))
+    chk.run()
+    _assert_bitwise(ref, chk)
+
+
+def test_chunked_pads_crossing_chunks_keep_state_intact():
+    """Review-caught corruption case: a mesh-pad duplicate landing in a
+    LATER chunk than its source must not recompute from the source's
+    already-updated persistent state. States are gathered once at round
+    start and scattered once at finalize, so a 14-client SCAFFOLD cohort
+    padded to 16 (duplicates in chunk 2, source in chunk 1) stays bitwise
+    equal to the unchunked, unpadded run."""
+    over = dict(backend="sp", opt="SCAFFOLD", m=14, n=16, rounds=3)
+    ref = Simulator(_cfg(**over))     # unchunked sp: no padding at all
+    ref.run()
+    chk = Simulator(_cfg(extra={"cohort_chunk": 8}, **over))
+    ids, w = chk._pad_ids(chk.sample_clients(0))
+    assert len(ids) == 16 and (w[14:] == 0).all() and ids[14] == ids[0]
+    chk.run()
+    _assert_bitwise(ref, chk)
+
+
+def test_chunked_blocked_and_dp_epsilon():
+    """Blocked chunked == per-round chunked == single-shot, with the DP
+    accountant advancing per round (dp_epsilon rows compare as part of the
+    exact history equality)."""
+    dp = {"enable_dp": True, "dp_solution_type": "ldp", "epsilon": 0.9,
+          "delta": 1e-5, "clipping_norm": 1.0}
+    over = dict(backend="sp", dp=dp, rounds=4)
+    ref = Simulator(_cfg(**over))
+    ref.run()
+    chk = Simulator(_cfg(extra={"cohort_chunk": 4}, **over))
+    chk.run()
+    blk = Simulator(_cfg(extra={"cohort_chunk": 4, "rounds_per_block": 2},
+                         **over))
+    blk.run()
+    assert all("dp_epsilon" in r for r in chk.history)
+    _assert_bitwise(ref, chk)
+    _assert_bitwise(chk, blk)
+
+
+def test_sample_clients_leaves_global_rng_alone(sp_pair):
+    """Satellite pin: round-seeded sampling draws the bit-identical ids the
+    old np.random.seed(round) path drew, WITHOUT perturbing the process
+    global numpy RNG other code shares."""
+    ref = sp_pair[0]
+    sim = Simulator(_cfg(m=8, n=16, rounds=1))
+    golden = np.sort(np.random.RandomState(5).choice(
+        range(16), 8, replace=False)).astype(np.int32)
+    np.testing.assert_array_equal(sim.sample_clients(5), golden)
+    # the global stream is NOT reset by sampling
+    np.random.seed(123)
+    a = np.random.rand()
+    np.random.seed(123)
+    sim.sample_clients(7)
+    ref.sample_clients(3)
+    b = np.random.rand()
+    assert a == b, "sample_clients perturbed the global numpy RNG"
+
+
+def test_ingest_pipeline_unit():
+    """Order preservation, prefetch accounting, sync fallback, and error
+    propagation of the ingest pipeline itself."""
+    import time
+
+    from fedml_tpu.simulation.ingest import IngestPipeline
+    from fedml_tpu.utils import metrics as mx
+
+    # order + prefetch: a slow consumer lets the worker run ahead
+    thunks = [lambda i=i: (np.full(4, i), 32) for i in range(6)]
+    got = []
+    for x in IngestPipeline(prefetch=1).stream(thunks):
+        time.sleep(0.01)
+        got.append(int(x[0]))
+    assert got == list(range(6))
+    snap = mx.snapshot()["counters"]
+    assert snap["fed.ingest.chunks"] == 6
+    assert snap["fed.ingest.bytes"] == 6 * 32
+    assert snap["fed.ingest.prefetched"] >= 1
+    # prefetch=0 degrades to inline execution, same metrics
+    assert [int(x[0]) for x in IngestPipeline(0).stream(thunks)] \
+        == list(range(6))
+    assert mx.snapshot()["counters"]["fed.ingest.chunks"] == 12
+
+    def boom():
+        raise RuntimeError("gather failed")
+
+    with pytest.raises(RuntimeError, match="gather failed"):
+        list(IngestPipeline(1).stream([thunks[0], boom, thunks[1]]))
+
+
+def test_chunk_knob_validation():
+    """Typo'd scale-out knobs fail at config load; a chunk that does not
+    divide into the mesh fails at Simulator init naming the mesh size; an
+    explicit health_stats=true alongside cohort_chunk is refused."""
+    for bad in (0, -2, 2.5, "many", True):
+        with pytest.raises(ValueError, match="cohort_chunk"):
+            _cfg(extra={"cohort_chunk": bad})
+    with pytest.raises(ValueError, match="ingest_prefetch"):
+        _cfg(extra={"cohort_chunk": 4, "ingest_prefetch": -1})
+    with pytest.raises(ValueError, match="requires cohort_chunk"):
+        _cfg(extra={"ingest_prefetch": 2})   # never silently ignored
+    with pytest.raises(ValueError, match="cost_model"):
+        _cfg(extra={"cost_model": "yes"})
+    with pytest.raises(ValueError, match="fit_after_rounds"):
+        _cfg(extra={"cost_model": {"fit_after_rounds": 0}})
+    with pytest.raises(ValueError, match="error_threshold"):
+        _cfg(extra={"cost_model": {"error_threshold": -1}})
+    with pytest.raises(ValueError, match="unknown cost_model"):
+        _cfg(extra={"cost_model": {"fit_after": 3}})
+    with pytest.raises(ValueError, match="health_stats"):
+        _cfg(extra={"cohort_chunk": 4, "health_stats": True})
+    _cfg(extra={"cohort_chunk": 4, "ingest_prefetch": 0,
+                "cost_model": True})          # ok
+    with pytest.raises(ValueError, match="multiple of"):
+        Simulator(_cfg(backend="xla", extra={"cohort_chunk": 3}))
+    with pytest.raises(ValueError, match="clients_per_device_parallel"):
+        Simulator(_cfg(extra={"cohort_chunk": 4,
+                              "clients_per_device_parallel": 3}))
+
+
+def test_cost_model_records_and_flips_schedule():
+    """The wall-time recording hook end-to-end: seeded fake durations make
+    the cost model engage deterministically and flip the balanced-LPT
+    permutation away from the size-based one."""
+    sim = Simulator(_cfg(backend="xla", m=16, n=16, rounds=1,
+                         extra={"cost_model": {"fit_after_rounds": 2,
+                                               "error_threshold": 10.0}}))
+    assert sim.mesh is not None and sim._cost_model is not None
+    sampled = sim.sample_clients(0)
+    ids_size, w_size = sim._pad_ids(sampled)     # size-LPT (not engaged)
+    assert not sim._cost_model.engaged()
+    # fake durations: cost INVERSELY related to size, so the predicted
+    # ranking must disagree with the sample-count ranking
+    counts = np.asarray(sim.counts)
+    for r in range(3):
+        for cid in range(16):
+            sim._cost_model.record_dispatch(
+                [cid], 100.0 / max(float(counts[cid]), 1.0))
+    assert sim._cost_model.engaged()
+    ids_cost, w_cost = sim._pad_ids(sampled)
+    assert sorted(ids_cost.tolist()) == sorted(sampled.tolist())
+    assert ids_cost.tolist() != ids_size.tolist(), \
+        "engaged cost model did not change the schedule"
+    # the engaged schedule balances PREDICTED runtime, not samples: its
+    # per-device predicted makespan must beat the size-LPT placement's
+    pred = {int(c): float(v) for c, v in zip(
+        range(16), sim._cost_model.predict_costs(range(16)))}
+    d = sim.mesh.devices.size
+    slots = len(ids_cost) // d
+
+    def makespan(row):
+        return max(sum(pred[int(c)] for c in row[k * slots:(k + 1) * slots])
+                   for k in range(d))
+
+    assert makespan(ids_cost) <= makespan(ids_size) + 1e-9
+
+
+def test_async_simulator_feeds_cost_model():
+    """The async loop records each merged client's (simulated) duration
+    per client — the sharpest estimator feed (satellite: async wiring)."""
+    from fedml_tpu.simulation.async_simulator import AsyncSimulator
+
+    cfg = _cfg(m=4, n=8, rounds=2)
+    cfg.train_args.extra["cost_model"] = True
+    sim = AsyncSimulator(cfg)
+    assert sim.cost_model is not None
+    sim.run(num_updates=6)
+    assert sim.cost_model.rounds_recorded == 6
+    hist = sim.cost_model.estimator.history[0]
+    assert sum(len(v) for v in hist.values()) == 6
+    assert all(t > 0 for ts in hist.values() for t in ts)
