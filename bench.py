@@ -781,6 +781,105 @@ def bench_serving_paged(quick: bool = False) -> dict:
     }
 
 
+def bench_serving_fleet(quick: bool = False) -> dict:
+    """Serving-fleet robustness rows (ISSUE 9) over a 2-replica
+    engine-backed LM deployment behind the gateway:
+
+    - ROLLING UPDATE UNDER LOAD: sustained concurrent /predict traffic
+      while round-2 LoRA adapters are published to the artifact store and
+      hot-swapped into both replicas via Deployment.rolling_update.
+      Acceptance bar: `serving_fleet_rolling_non2xx` == 0 (no shedding is
+      armed, so NO refusal is deliberate) and both replicas report v2.
+    - OVERLOAD SHEDDING: a burst well past fleet capacity, once against
+      a no-shedding gateway (everything queues) and once with
+      `shed_watermark` armed (excess refused with 429 + Retry-After).
+      Reported: 429 count and the p99 latency of ACCEPTED requests both
+      ways — shedding must keep the accepted p99 bounded (the ratio is
+      the row), because overload is supposed to degrade to fast refusal,
+      not piled-up timeouts.
+    - STREAM TTFT: time-to-first-streamed-token through the gateway SSE
+      relay, measured client-side."""
+    import urllib.request
+
+    from fedml_tpu.serving.fleet_harness import FleetHarness, post
+
+    if quick:
+        dims = dict(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+                    d_ff=64)
+    else:
+        dims = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=4,
+                    d_ff=256)
+    slots, max_len = 4, 64
+    fleet = FleetHarness(**dims, slots=slots, max_len=max_len,
+                         lora_rank=4, prompt_len=10)
+    prompt = fleet.prompt
+
+    def p99(lat_ms):
+        s = sorted(lat_ms)
+        return s[min(len(s) - 1, int(0.99 * (len(s) - 1)))] if s else None
+
+    try:
+        # ---------------- phase 1: rolling adapter update under load
+        gw = fleet.gateway()
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        post(url, {"tokens": prompt, "max_new_tokens": 4})       # compile
+        results, stop_load = fleet.sustained_load(
+            url, 4, {"tokens": prompt, "max_new_tokens": 8})
+        time.sleep(0.3)                      # load established before swap
+        _updated, swap_s = fleet.publish_and_roll(version=2, timeout=60)
+        time.sleep(0.3)
+        stop_load(timeout=30)
+        non2xx = [c for c, _ in results if c != 200]
+        versions = fleet.dep.versions()
+
+        # ---------------- phase 3: stream TTFT through the gateway relay
+        body = json.dumps({"tokens": prompt, "max_new_tokens": 16,
+                           "stream": True}).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.readline()                     # first `data:` event
+            ttft_s = time.perf_counter() - t0
+            r.read()
+
+        # ---------------- phase 2: overload — no-shed baseline, then shed
+        n_threads, new, dur = (8, 8, 2.0) if quick else (16, 16, 3.0)
+        payload = {"tokens": prompt, "max_new_tokens": new}
+        noshed = fleet.burst(url, n_threads, payload, dur)
+        gw.stop()
+        gw2 = fleet.gateway(shed_watermark=2.0)
+        shed = fleet.burst(f"http://127.0.0.1:{gw2.port}/predict",
+                           n_threads, payload, dur)
+    finally:
+        fleet.close()
+    noshed_ok = [dt * 1e3 for c, dt in noshed if c == 200]
+    shed_ok = [dt * 1e3 for c, dt in shed if c == 200]
+    n429 = sum(1 for c, _ in shed if c == 429)
+    stray = sorted({c for c, _ in shed if c not in (200, 429)})
+    p99_noshed, p99_shed = p99(noshed_ok), p99(shed_ok)
+    return {
+        "serving_fleet_rolling_requests": len(results),
+        "serving_fleet_rolling_non2xx": len(non2xx),
+        "serving_fleet_rolling_swap_ms": round(swap_s * 1e3, 1),
+        "serving_fleet_versions_after": versions,
+        "serving_fleet_stream_ttft_ms": round(ttft_s * 1e3, 1),
+        "serving_fleet_shed_429s": n429,
+        "serving_fleet_shed_stray_codes": stray,
+        "serving_fleet_accepted_p99_ms_noshed": (
+            round(p99_noshed, 1) if p99_noshed is not None else None),
+        "serving_fleet_accepted_p99_ms_shed": (
+            round(p99_shed, 1) if p99_shed is not None else None),
+        "serving_fleet_shed_p99_ratio": (
+            round(p99_shed / p99_noshed, 2)
+            if p99_shed and p99_noshed else None),
+        "serving_fleet_config": (
+            f"2 replicas slots{slots} d{dims['d_model']} "
+            f"L{dims['n_layers']} burst{n_threads}x{new}tok "
+            f"watermark2.0" + (" quick" if quick else "")),
+    }
+
+
 def bench_sim_scale(quick: bool = False) -> dict:
     """Parrot-scale simulation rows (ISSUE 8): a 1024-client CPU round run
     chunked+streamed vs single-shot.
@@ -1481,6 +1580,12 @@ _HEADLINE_KEYS = (
     "serving_paged_ttft_p99_ms_chunked",
     "serving_paged_ttft_p99_ms_monolithic",
     "serving_paged_prefix_hit_flatness_224_over_64",
+    # serving-fleet robustness (ISSUE 9): rolling swap + shed + stream
+    "serving_fleet_rolling_non2xx", "serving_fleet_rolling_requests",
+    "serving_fleet_shed_429s", "serving_fleet_shed_p99_ratio",
+    "serving_fleet_accepted_p99_ms_shed",
+    "serving_fleet_accepted_p99_ms_noshed",
+    "serving_fleet_stream_ttft_ms",
     # Parrot-scale cohorts (ISSUE 8): chunked/streamed rounds + cost-LPT
     "sim_scale_hbm_headroom_ratio", "sim_scale_ingest_overhead_pct",
     "sim_scale_chunked_vs_unchunked_pct",
@@ -1544,6 +1649,8 @@ def main():
                {"serving_cb_error": "bench_serving_cb failed twice"})
     acc.update(_retrying(bench_serving_paged, quick, default=None) or
                {"serving_paged_error": "bench_serving_paged failed twice"})
+    acc.update(_retrying(bench_serving_fleet, quick, default=None) or
+               {"serving_fleet_error": "bench_serving_fleet failed twice"})
     acc.update(_retrying(bench_sim_scale, quick, default=None) or
                {"sim_scale_error": "bench_sim_scale failed twice"})
     if not quick:

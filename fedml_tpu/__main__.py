@@ -443,6 +443,33 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             if p50 is not None:
                 seg += f"  p50<={p50 * 1e3:.2f}ms"
         lines.append(seg)
+        # fleet-control plane (ISSUE 9): replica pool health, model
+        # versions across the rolling updater, load sheds, streaming
+        if ("serving_replicas_ready" in g or "serving_model_version" in g
+                or c.get("serving_shed_total")):
+            seg = (f"fleet: ready {int(g.get('serving_replicas_ready', 0))}"
+                   f"  suspect "
+                   f"{int(g.get('serving_replicas_suspect', 0))}")
+            ver = g.get("serving_fleet_version",
+                        g.get("serving_model_version"))
+            if ver is not None:
+                seg += f"  version {int(ver)}"
+            seg += f"  shed {int(c.get('serving_shed_total', 0))}"
+            sr = rate("serving_shed_total")
+            if sr is not None:
+                seg += f"  shed/s {sr:.1f}"
+            rec = int(c.get("serving_replica_recoveries_total", 0))
+            if rec:
+                seg += f"  recovered {rec}"
+            fo = int(c.get("serving_stream_failovers_total", 0))
+            if fo:
+                seg += f"  stream_failovers {fo}"
+            st = h.get("serving_stream_ttft")
+            if st and st["count"]:
+                p50 = histogram_percentile(st["buckets"], 0.5)
+                if p50 is not None:
+                    seg += f"  stream_ttft_p50<={p50 * 1e3:.2f}ms"
+            lines.append(seg)
         # continuous-batching engine plane (serving/engine.py)
         if "serving_tokens_total" in c:
             seg = (f"engine: tokens {int(c['serving_tokens_total'])}  "
@@ -904,6 +931,59 @@ def cmd_diagnosis(args) -> int:
                 "pages_free": int(free), "prefix_resident": resident,
                 "programs": counts}
 
+    def fleet_rolling_update_smoke():
+        # the serving-fleet robustness plane end-to-end (ISSUE 9): a
+        # 2-replica engine-backed LM deployment under sustained
+        # concurrent load takes a v1 -> v2 adapter hot swap through the
+        # rolling updater — zero non-2xx responses (no shedding armed,
+        # so NONE are deliberate), both replicas report model_version 2
+        # on /info afterwards, and a streamed request records a
+        # first-token time. The zero-dropped bar is the whole point:
+        # model churn must not cost requests.
+        import json as _json
+        import urllib.request as _ur
+
+        from .serving.fleet_harness import FleetHarness
+        from .utils import metrics as mx
+
+        fleet = FleetHarness()    # probe-lean dims are the harness defaults
+        try:
+            gw = fleet.gateway()
+            url = f"http://127.0.0.1:{gw.port}/predict"
+            results, stop_load = fleet.sustained_load(
+                url, 3, {"tokens": fleet.prompt, "max_new_tokens": 4})
+            updated, _swap_s = fleet.publish_and_roll(version=2,
+                                                      timeout=30)
+            # one streamed request through the gateway records TTFT
+            req = _ur.Request(url, data=_json.dumps(
+                {"tokens": fleet.prompt, "max_new_tokens": 4,
+                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with _ur.urlopen(req, timeout=60) as r:
+                body = r.read().decode()
+            stop_load(timeout=10)
+            versions = fleet.dep.versions()
+        finally:
+            fleet.close()
+        codes = [cd for cd, _lat in results]
+        bad = [cd for cd in codes if cd != 200]
+        if bad:
+            raise ValueError(
+                f"rolling update dropped requests: {len(bad)}/{len(codes)} "
+                f"non-2xx (codes {sorted(set(bad))})")
+        if len(updated) != 2 or any(v != 2 for v in versions.values()):
+            raise ValueError(f"fleet did not converge on v2: {versions}")
+        if '"done": true' not in body:
+            raise ValueError("streamed response never completed")
+        snap = mx.snapshot()
+        if not snap["histograms"].get("serving.stream_ttft", {}).get(
+                "count"):
+            raise ValueError("serving.stream_ttft never recorded")
+        return {"requests_under_swap": len(codes), "non_2xx": 0,
+                "versions": versions,
+                "swaps": int(snap["counters"].get(
+                    "serving.engine.swaps", 0))}
+
     def partition_rules_smoke():
         # the partitioning plane end-to-end (ISSUE 6): build the registry,
         # resolve the flagship TransformerLM in its serving shape (scan
@@ -987,10 +1067,12 @@ def cmd_diagnosis(args) -> int:
               "chaos_smoke": chaos_smoke,
               "serving_engine_smoke": serving_engine_smoke,
               "serving_paged_smoke": serving_paged_smoke,
+              "fleet_rolling_update_smoke": fleet_rolling_update_smoke,
               "partition_rules_smoke": partition_rules_smoke,
               "cohort_sharded_smoke": cohort_sharded_smoke}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
+                "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration

@@ -264,12 +264,28 @@ def _load_registered(name: str) -> dict:
     return spec
 
 
+def _serve_extra(config) -> dict:
+    """Config (or a bare serve_args.extra dict, or None) -> the validated
+    fleet-knob dict scheduler.fleet_knobs translates."""
+    if config is None:
+        return {}
+    sv = getattr(config, "serve_args", None)
+    if sv is not None:
+        return dict(getattr(sv, "extra", {}) or {})
+    return dict(config)
+
+
 def model_deploy(name: str, cluster: LocalCluster, n_replicas: int = 1,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, config=None):
     """reference: api model_deploy — deploy a registered model to workers;
     local: the serving scheduler's deploy FSM over the cluster's master.
-    Returns the Deployment (attach an InferenceGateway for routing)."""
-    from .serving.scheduler import Deployment
+    Returns the Deployment (attach a gateway via model_gateway for
+    routing). `config` (a fedml_tpu Config or a serve_args.extra dict)
+    routes the validated fleet knobs — probation_deadline_s /
+    probe_backoff_s — through scheduler.fleet_knobs into the Deployment;
+    without this consumer the YAML knobs would validate at load and then
+    silently drop."""
+    from .serving.scheduler import Deployment, fleet_knobs
 
     spec = _load_registered(name)
     serve_spec = {"model": spec["model"],
@@ -277,10 +293,25 @@ def model_deploy(name: str, cluster: LocalCluster, n_replicas: int = 1,
                   "model_args": spec.get("model_args", {}),
                   "params": spec.get("params"),
                   "requirements": {}}
+    dep_kw, _gw_kw = fleet_knobs(_serve_extra(config))
     dep = Deployment(cluster.master, serve_spec, min_replicas=n_replicas,
-                     max_replicas=max(n_replicas, len(cluster.workers)))
+                     max_replicas=max(n_replicas, len(cluster.workers)),
+                     **dep_kw)
     dep.deploy(n_replicas, timeout=timeout)
     return dep
+
+
+def model_gateway(deployment, config=None, **kwargs):
+    """Start an InferenceGateway over a Deployment with the config's
+    fleet knobs — shed_watermark / retry_after_s — applied (the gateway
+    half of scheduler.fleet_knobs; model_deploy consumes the Deployment
+    half). Explicit keyword arguments override the config. Returns the
+    STARTED gateway; callers own gw.stop()."""
+    from .serving.scheduler import InferenceGateway, fleet_knobs
+
+    _dep_kw, gw_kw = fleet_knobs(_serve_extra(config))
+    gw_kw.update(kwargs)
+    return InferenceGateway(deployment, **gw_kw).start()
 
 
 # ------------------------------------------------------ profile (no SaaS)

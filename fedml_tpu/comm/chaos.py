@@ -66,6 +66,15 @@ class FaultSpec:
                          (in-jit mask, parallel/round.py)
       client_straggler — P(a sampled client misses the round deadline; its
                          report is discarded like a timeout-closed round)
+      replica_kill     — {replica_rank: n}: the SERVING-replica crash
+                         schedule (ISSUE 9) — the replica's HTTP surface
+                         dies (listening socket closed, in-flight
+                         connections severed, no drain) the moment it has
+                         streamed its n-th token. Consumed by
+                         serving/inference_runner.py, which takes the
+                         spec at construction; deterministic like every
+                         other schedule here, so a mid-stream failover
+                         test replays exactly.
     """
 
     seed: int = 0
@@ -79,6 +88,7 @@ class FaultSpec:
     flap: dict = dataclasses.field(default_factory=dict)
     client_dropout: float = 0.0
     client_straggler: float = 0.0
+    replica_kill: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         for f in _PROB_FIELDS + ("client_dropout", "client_straggler"):
@@ -97,16 +107,24 @@ class FaultSpec:
             raise ValueError(
                 f"common_args.extra.chaos.seed must be an int; got "
                 f"{self.seed!r}")
-        for name, sched in (("crash", self.crash), ("flap", self.flap)):
+        for name, sched in (("crash", self.crash), ("flap", self.flap),
+                            ("replica_kill", self.replica_kill)):
             if not isinstance(sched, dict):
                 raise ValueError(
                     f"common_args.extra.chaos.{name} must be a dict keyed by "
                     f"rank; got {sched!r}")
-        for rank, n in self.crash.items():
-            if not (isinstance(n, int) and not isinstance(n, bool) and n >= 0):
-                raise ValueError(
-                    "common_args.extra.chaos.crash values must be "
-                    f"non-negative send counts; got {rank!r}: {n!r}")
+        for sched_name, sched in (("crash", self.crash),
+                                  ("replica_kill", self.replica_kill)):
+            # replica_kill fires AFTER the n-th streamed token, so 0 would
+            # silently behave as 1 — refuse it (kill-before-first-byte is
+            # a listening-socket kill, not a mid-stream schedule)
+            floor = 1 if sched_name == "replica_kill" else 0
+            for rank, n in sched.items():
+                if not (isinstance(n, int) and not isinstance(n, bool)
+                        and n >= floor):
+                    raise ValueError(
+                        f"common_args.extra.chaos.{sched_name} values must "
+                        f"be counts >= {floor}; got {rank!r}: {n!r}")
         for rank, cyc in self.flap.items():
             ok = (isinstance(cyc, dict)
                   and isinstance(cyc.get("up"), int) and cyc["up"] >= 1
@@ -138,9 +156,10 @@ class FaultSpec:
             raise ValueError(
                 f"unknown common_args.extra.chaos keys {unknown} "
                 f"(known: {sorted(known)})")
-        # YAML keys arrive as strings; crash/flap schedules are rank-keyed
+        # YAML keys arrive as strings; crash/flap/replica_kill schedules
+        # are rank-keyed
         norm = dict(d)
-        for sched in ("crash", "flap"):
+        for sched in ("crash", "flap", "replica_kill"):
             if isinstance(norm.get(sched), dict):
                 norm[sched] = {int(k): v for k, v in norm[sched].items()}
         return cls(**norm)
@@ -169,6 +188,13 @@ class FaultSpec:
             return False
         u, d = int(cyc["up"]), int(cyc["down"])
         return (n_sends - 1) % (u + d) >= u
+
+    def replica_killed(self, rank: int, n_tokens: int) -> bool:
+        """True once serving replica `rank` has streamed `n_tokens` >= its
+        scheduled kill count — the inference runner then dies mid-stream
+        (serving/inference_runner.py consumes this)."""
+        after = self.replica_kill.get(rank)
+        return after is not None and n_tokens >= after
 
 
 class ChaosTransport(BaseTransport, Observer):

@@ -164,7 +164,19 @@ class ServeArgs:
       sampler_cache_size — LRU cap on per-top_k compiled samplers
       engine_mp          — >1 runs the engine tensor-parallel over an
                           {"mp": N} mesh (weights + persistent KV cache
-                          sharded via the parallel/partition.py registry)"""
+                          sharded via the parallel/partition.py registry)
+    Fleet knobs (ISSUE 9 — serving/scheduler.py consumes them through
+    scheduler.fleet_knobs; drain_timeout_s rides the predictor mapping):
+      drain_timeout_s      — bound on stop(drain=True): how long in-flight
+                             decodes get to finish at scale-down
+      shed_watermark       — >0 arms gateway load shedding: above
+                             watermark × ready_replicas in-flight, new
+                             requests get 429 + Retry-After
+      retry_after_s        — the Retry-After hint on sheds
+      probation_deadline_s — how long a SUSPECT replica gets to answer
+                             /ready again before it is declared DEAD
+      probe_backoff_s      — initial probation re-probe interval
+                             (exponential, capped at 1s)"""
     extra: dict = field(default_factory=dict)
 
 
@@ -402,7 +414,9 @@ class Config:
                         "engine_fetch_chunk", "engine_eos_id",
                         "sampler_cache_size", "kv_cache", "engine_mp",
                         "kv_page_size", "kv_n_pages", "prefill_chunk",
-                        "prefix_cache"}
+                        "prefix_cache", "drain_timeout_s", "shed_watermark",
+                        "retry_after_s", "probation_deadline_s",
+                        "probe_backoff_s"}
         unknown = set(self.serve_args.extra) - _serve_knobs
         if unknown:
             raise ValueError(
@@ -432,6 +446,26 @@ class Config:
             if not ok:
                 raise ValueError(
                     f"serve_args.{knob} must be an integer >= {lo}; "
+                    f"got {val!r}")
+        # fleet knobs (ISSUE 9) are durations/ratios — positive numbers
+        # (drain_timeout_s/shed_watermark may be 0 = disabled)
+        for knob, strict in (("drain_timeout_s", False),
+                             ("shed_watermark", False),
+                             ("retry_after_s", True),
+                             ("probation_deadline_s", True),
+                             ("probe_backoff_s", True)):
+            val = self.serve_args.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = (not isinstance(val, bool)
+                      and (float(val) > 0 if strict else float(val) >= 0))
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"serve_args.{knob} must be a "
+                    f"{'positive' if strict else 'non-negative'} number; "
                     f"got {val!r}")
         # engine_mp only takes effect inside the engine (decode_slots > 0):
         # a config asking for tensor-parallel serving without the engine

@@ -91,6 +91,31 @@ Equivalence contract: for identical prompts, greedy engine output is
 token-identical to the per-request path — the slot axis is data-parallel
 through the decode math (pinned in tests/test_serving_engine.py).
 
+FLEET ROBUSTNESS (ISSUE 9) — the three production failure shapes a
+federated deployment meets are model churn, overload, and mid-request
+replica death; the engine carries the first and last:
+
+- HOT ADAPTER SWAP: `swap_adapters(tree, version=)` replaces the LoRA
+  adapter values ATOMICALLY between decode iterations — no KV-cache
+  teardown, no restart, no recompile. The compiled step/admit programs
+  are layout-stable because adapters are replicated (the
+  `partition.TABLES["lora"]` contract), so only the VALUES may change:
+  a swap whose tree structure/shapes/dtypes differ from the serving
+  tree is refused (that change needs a redeploy, and silently accepting
+  it would retrace every program). In-flight requests finish on the NEW
+  adapters from their next step — the federated rolling-update
+  semantic: round N+1's adapters take effect mid-decode rather than
+  holding traffic. `model_version` is monotonic and rides the
+  `serving.model_version` gauge + a `serving.swap` span.
+- STREAMING TICKETS: `Ticket.stream()` yields tokens AS the host
+  observes their retirement frames (granularity = `fetch_chunk`), so
+  the HTTP tier can emit SSE chunks while the request still decodes;
+  `result()` is unchanged.
+- GRACEFUL DRAIN: `stop(drain=True)` refuses new submits and lets every
+  accepted request finish (bounded by `drain_timeout_s`) before
+  teardown — a scale-down or rolling replica replacement never errors a
+  ticket that was already decoding.
+
 Telemetry rides the existing planes: `serving.ttft` / `serving.tbt`
 histograms, `serving.slots_active` gauge, `serving.tokens_total` counter,
 `serving.engine.*` counters, and `serving.engine.admit` / `.fetch` spans
@@ -166,12 +191,17 @@ class _Admission:
 class Ticket:
     """Per-request handle: the HTTP handler blocks on `result()` while the
     engine thread decodes — requests no longer serialize through one
-    global jit call; concurrency is bounded by slots, not threads."""
+    global jit call; concurrency is bounded by slots, not threads.
 
-    __slots__ = ("_done", "_tokens", "_error", "t_submit", "t_first",
+    Tokens are PUSHED as the host observes their retirement frames, so
+    `stream()` can relay them while the request still decodes (the SSE
+    serving surface); `result()` keeps the block-until-done contract."""
+
+    __slots__ = ("_cv", "_done", "_tokens", "_error", "t_submit", "t_first",
                  "t_done")
 
     def __init__(self):
+        self._cv = threading.Condition()
         self._done = threading.Event()
         self._tokens: list[int] = []
         self._error: Optional[BaseException] = None
@@ -179,6 +209,20 @@ class Ticket:
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
 
+    # engine-thread side -------------------------------------------------
+    def _push(self, tok: int) -> None:
+        with self._cv:
+            self._tokens.append(tok)
+            self._cv.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            if error is not None and self._error is None:
+                self._error = error
+            self._done.set()
+            self._cv.notify_all()
+
+    # caller side --------------------------------------------------------
     def result(self, timeout: Optional[float] = None) -> list[int]:
         """Block until the request retires; returns the generated tokens
         (the eos token, when one ended generation, is included)."""
@@ -187,7 +231,30 @@ class Ticket:
                                f"after {timeout}s")
         if self._error is not None:
             raise self._error
-        return list(self._tokens)
+        with self._cv:
+            return list(self._tokens)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as the engine retires them (granularity = the
+        engine's `fetch_chunk` frames). `timeout` bounds the wait for
+        EACH next token, not the whole request. Raises the ticket's
+        error (engine crash / stop) after yielding whatever tokens
+        arrived before it — the caller decides how a half-stream is
+        surfaced."""
+        i = 0
+        while True:
+            with self._cv:
+                while i >= len(self._tokens) and not self._done.is_set():
+                    if not self._cv.wait(timeout):
+                        raise TimeoutError(
+                            f"no token from the decode engine in {timeout}s")
+                if i >= len(self._tokens):
+                    if self._error is not None:
+                        raise self._error
+                    return
+                tok = self._tokens[i]
+            yield tok           # outside the lock: the consumer may block
+            i += 1
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -202,6 +269,67 @@ class _Request:
         self.temperature = temperature
         self.seed = seed
         self.ticket = Ticket()
+
+
+class _Swap:
+    """One queued hot adapter swap, applied by the engine thread between
+    decode iterations; `applied` releases the waiting caller."""
+
+    __slots__ = ("adapters", "version", "applied", "error")
+
+    def __init__(self, adapters, version: int):
+        self.adapters = adapters
+        self.version = version
+        self.applied = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+def check_adapter_swap(current: Pytree, new: Pytree) -> None:
+    """The layout-stability contract behind hot swap: the replacement
+    adapter tree must match the serving tree's STRUCTURE, shapes, and
+    dtypes exactly — those are baked into every compiled program (and,
+    on a mesh, into the pinned shardings), so a mismatch would force a
+    retrace (or worse, silently serve garbage). Raises ValueError naming
+    the first offending leaf."""
+    cur_flat = jax.tree_util.tree_flatten_with_path(current)[0]
+    new_flat = jax.tree_util.tree_flatten_with_path(new)[0]
+    cur_td = jax.tree_util.tree_structure(current)
+    new_td = jax.tree_util.tree_structure(new)
+    if cur_td != new_td:
+        raise ValueError(
+            "adapter swap tree structure differs from the serving tree — "
+            "a structural change retraces every compiled program; "
+            "redeploy the replica instead (hot swap replaces VALUES of "
+            "the layout the engine was built with)")
+    for (path, a), (_p, b) in zip(cur_flat, new_flat):
+        if a.shape != b.shape or a.dtype != b.dtype:
+            name = "/".join(str(getattr(p, "key", p)) for p in path)
+            raise ValueError(
+                f"adapter swap leaf {name!r} is {b.shape}/{b.dtype}; the "
+                f"serving tree has {a.shape}/{a.dtype} — shapes and dtypes "
+                "are compile-time constants of the decode programs")
+
+
+def prepare_adapter_swap(current: Pytree, adapters: Pytree, n_layers: int,
+                         current_version: int, version: Optional[int],
+                         who: str = "the engine") -> tuple[Pytree, int]:
+    """The validate-and-version step shared by DecodeEngine.swap_adapters
+    and GreedyLMPredictor's no-engine fallback: stack the per-block
+    adapter tree, refuse empty trees and layout changes
+    (check_adapter_swap), and compute the monotonic target version.
+    Returns (stacked_tree, new_version)."""
+    from ..llm.decode import stack_adapter_blocks
+
+    stacked = stack_adapter_blocks(adapters, n_layers)
+    if not stacked:
+        raise ValueError("swap_adapters needs a non-empty adapter tree")
+    check_adapter_swap(current, stacked)
+    ver = current_version + 1 if version is None else int(version)
+    if ver <= current_version:
+        raise ValueError(
+            f"model_version must be monotonic: swap to {ver} but "
+            f"{who} already serves {current_version}")
+    return stacked, ver
 
 
 class _SlotState:
@@ -559,7 +687,11 @@ class DecodeEngine:
         self._free: list[int] = list(range(S))
         self._slots: list[Optional[_SlotState]] = [None] * S
         self._stopping = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
+        self._version = 0
+        self._pending_swap: Optional[_Swap] = None
+        _mx.set_gauge("serving.model_version", 0)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "DecodeEngine":
@@ -568,13 +700,106 @@ class DecodeEngine:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Refuse new submits and wait (bounded) for every ACCEPTED
+        request — decoding slots and queued ones — to finish. One-way:
+        a drained engine only goes on to stop(). Returns False when the
+        deadline expired with work still in flight (stop() then errors
+        those tickets as before — the drain was best-effort, bounded)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            deadline = time.monotonic() + timeout_s
+            while self._waiting or any(s is not None for s in self._slots):
+                if (self._stopping or self._thread is None
+                        or not self._thread.is_alive()):
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    _mx.inc("serving.engine.drain_timeouts")
+                    return False
+                self._cond.wait(min(0.1, left))
+        return True
+
+    def stop(self, drain: bool = False,
+             drain_timeout_s: float = 30.0) -> None:
+        """Tear the engine down. `drain=True` first lets in-flight slots
+        finish (bounded by `drain_timeout_s`) so a scale-down or rolling
+        replica swap never errors a request that was already decoding;
+        whatever is still in flight when the deadline expires is errored
+        as before."""
+        if drain and self._thread is not None and self._thread.is_alive():
+            self.drain(drain_timeout_s)
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
         self._fail_outstanding(RuntimeError("decode engine stopped"))
+
+    # ------------------------------------------------------------- hot swap
+    @property
+    def model_version(self) -> int:
+        return self._version
+
+    def swap_adapters(self, adapters: Pytree,
+                      version: Optional[int] = None,
+                      timeout: float = 60.0) -> int:
+        """Hot-swap the LoRA adapter VALUES the engine serves — applied
+        by the engine thread between decode iterations, so no step ever
+        mixes versions, the persistent KV cache survives untouched, and
+        no program retraces (adapters are replicated per the
+        partition.TABLES["lora"] contract; structure/shape/dtype changes
+        are refused — see check_adapter_swap). In-flight requests finish
+        on the new adapters from their next step. Returns the new
+        monotonic `model_version` (default: current + 1)."""
+        if self.adapters is None:
+            raise ValueError(
+                "this engine was built without adapters — hot swap "
+                "replaces adapter VALUES only (the compiled programs' "
+                "signature is fixed at construction); deploy the replica "
+                "with adapters (zero-initialized LoRA serves the base "
+                "model exactly) to enable rolling updates")
+        with self._cond:
+            stacked, ver = prepare_adapter_swap(
+                self.adapters, adapters, self.model.n_layers,
+                self._version, version)
+            if self.mesh is not None:
+                from ..parallel import partition
+
+                stacked = partition.shard_params(stacked, self.mesh,
+                                                 "lora")
+            if self._pending_swap is not None:
+                raise RuntimeError(
+                    "an adapter swap is already pending — serialize "
+                    "swaps (the rolling updater does)")
+            swap = _Swap(stacked, ver)
+            running = (self._thread is not None and self._thread.is_alive()
+                       and not self._stopping)
+            if running:
+                self._pending_swap = swap
+                self._cond.notify_all()
+        if not running:
+            # no decode thread -> no iteration boundary to respect; the
+            # per-request degrade path still serves the new values
+            self._apply_swap(swap)
+            return self._version
+        if not swap.applied.wait(timeout):
+            raise TimeoutError(f"adapter swap not applied in {timeout}s")
+        if swap.error is not None:
+            raise swap.error
+        return self._version
+
+    def _apply_swap(self, swap: _Swap) -> None:
+        """Engine-thread (or stopped-engine) application point: ONE
+        attribute assignment between jit dispatches — the next admit/step
+        call reads the new tree; nothing about the carry changes."""
+        with recorder.span("serving.swap", version=swap.version):
+            self.adapters = swap.adapters
+            self._version = swap.version
+        _mx.set_gauge("serving.model_version", swap.version)
+        _mx.inc("serving.engine.swaps")
+        swap.applied.set()
 
     # ------------------------------------------------------------ admission
     def submit(self, tokens, max_new_tokens: int,
@@ -606,6 +831,10 @@ class DecodeEngine:
             if self._stopping or (self._thread is not None
                                   and not self._thread.is_alive()):
                 raise RuntimeError("decode engine is stopped")
+            if self._draining:
+                raise RuntimeError(
+                    "decode engine is draining (replica stopping) — "
+                    "request refused")
             if self._thread is None:
                 raise RuntimeError("decode engine not started "
                                    "(call .start())")
@@ -675,11 +904,17 @@ class DecodeEngine:
                 with self._cond:
                     if self._stopping:
                         break
-                    idle = (not self._waiting and not pending
+                    swap, self._pending_swap = self._pending_swap, None
+                    idle = (swap is None and not self._waiting and not pending
                             and all(s is None for s in self._slots))
                     if idle:
                         self._cond.wait(0.2)
                         continue
+                if swap is not None:
+                    # between iterations, by construction: the previous
+                    # iteration's dispatches hold their own references,
+                    # every later one reads the new tree
+                    self._apply_swap(swap)
                 if self._paged:
                     self._advance_admissions(pending)
                 else:
@@ -968,6 +1203,9 @@ class DecodeEngine:
             st.t_first = now
             st.req.ticket.t_first = now
             _mx.observe("serving.ttft", now - st.req.ticket.t_submit)
+        # push BEFORE the done decision: a stream() consumer sees every
+        # token, including the one that retires the slot
+        st.req.ticket._push(tok)
         done = (tok == self._eos) or (len(st.out) >= st.req.max_new)
         if done:
             # avg time-between-tokens over the request's decode phase (the
@@ -976,7 +1214,6 @@ class DecodeEngine:
             if len(st.out) > 1 and st.t_first is not None:
                 _mx.observe("serving.tbt",
                             (now - st.t_first) / (len(st.out) - 1))
-            st.req.ticket._tokens = st.out
             st.req.ticket.t_done = now
             if self._paged:
                 # release BEFORE the done event: a waiter returning from
@@ -984,7 +1221,7 @@ class DecodeEngine:
                 # observe the pool already reclaimed — releasing after
                 # set() leaves a window where free+resident < budget
                 self._release_slot_pages(st)
-            st.req.ticket._done.set()
+            st.req.ticket._finish()
             with self._cond:
                 self._slots[slot] = None
                 # a stop() may have reset the free list already — don't
@@ -1001,6 +1238,11 @@ class DecodeEngine:
             slots = [s for s in self._slots if s is not None]
             self._slots = [None] * self.n_slots
             self._free = list(range(self.n_slots))
+            swap, self._pending_swap = self._pending_swap, None
+        if swap is not None:
+            # release the waiting swapper with the failure, not a timeout
+            swap.error = err
+            swap.applied.set()
         if self._paged:
             # the device cache is garbage after a crash — every page and
             # every cached prefix goes with it
@@ -1013,8 +1255,6 @@ class DecodeEngine:
         _mx.set_gauge("serving.engine.queue", 0)
         _mx.set_gauge("serving.slots_active", 0)
         for r in reqs:
-            r.ticket._error = err
-            r.ticket._done.set()
+            r.ticket._finish(err)
         for s in slots:
-            s.req.ticket._error = err
-            s.req.ticket._done.set()
+            s.req.ticket._finish(err)

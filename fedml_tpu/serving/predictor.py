@@ -48,6 +48,15 @@ class InvalidRequest(ValueError):
     JAX shape/dtype errors as client errors."""
 
 
+class StaleVersion(InvalidRequest):
+    """The request PINNED a `model_version` this replica does not serve
+    (rolling update in progress). The HTTP layer maps this to 409 — its
+    own code because the gateway's contract differs from both 4xx and
+    5xx: the replica is healthy (never marked dead) but the request
+    should be RETRIED on a sibling replica that already (or still)
+    serves the pinned version."""
+
+
 def _req_int(input_json: dict, key: str, default) -> int:
     try:
         return int(input_json.get(key, default))
@@ -81,7 +90,19 @@ class _InstrumentedPredictor:
             out, key = self._predict(input_json)
             first = key not in compiled
             sp.meta["compile"] = first
+        # the program compiled whether or not the pin below 409s —
+        # record it first, or the next same-shape request would land its
+        # serve-time latency in the compile histogram
         compiled.add(key)
+        # pin is re-checked AFTER compute: a hot swap that lands while
+        # this request decodes makes the engine finish in-flight slots on
+        # the NEW adapters — returning that output under an old-version
+        # pin would be the spliced mixed-version answer pinning exists to
+        # prevent. The 409 reroutes to a sibling (decode cost is the
+        # price of the read-your-round contract).
+        chk = getattr(self, "_check_pin", None)
+        if chk is not None:
+            chk(input_json)
         _mx.inc("serving.predictions")
         _mx.observe("serving.predict.compile_s" if first
                     else "serving.predict.serve_s",
@@ -96,7 +117,7 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
     """THE serve-knob -> GreedyLMPredictor mapping (decode_slots,
     engine_max_len, engine_eos_id, engine_fetch_chunk, sampler_cache_size,
     kv_cache, engine_mp, kv_page_size, kv_n_pages, prefill_chunk,
-    prefix_cache), shared by the config route
+    prefix_cache, drain_timeout_s), shared by the config route
     (serving.lm_predictor_from_config reads Config.serve_args.extra) and
     the deploy route (scheduler.start_replica reads the spec's serve
     dict) — one mapping, so the two surfaces cannot drift."""
@@ -114,7 +135,8 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
         kv_page_size=int(sv.get("kv_page_size", 0)),
         kv_n_pages=None if n_pages is None else int(n_pages),
         prefill_chunk=int(sv.get("prefill_chunk", 0)),
-        prefix_cache=bool(sv.get("prefix_cache", True)))
+        prefix_cache=bool(sv.get("prefix_cache", True)),
+        drain_timeout_s=float(sv.get("drain_timeout_s", 30.0)))
 
 
 def _bucket(n: int, pow2_cap: int = 1024) -> int:
@@ -211,7 +233,7 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                  sampler_cache_size: int = 4, engine_fetch_chunk: int = 2,
                  engine_mp: int = 0, kv_page_size: int = 0,
                  kv_n_pages: Optional[int] = None, prefill_chunk: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, drain_timeout_s: float = 30.0):
         self.model = model
         self.params = params
         self.detokenize = detokenize
@@ -220,6 +242,8 @@ class GreedyLMPredictor(_InstrumentedPredictor):
         self.adapters = adapters
         self.engine = None
         self.eos_id = eos_id
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._version = 0
 
         if decode_slots and not kv_cache:
             raise ValueError(
@@ -350,17 +374,90 @@ class GreedyLMPredictor(_InstrumentedPredictor):
 
         self._generate = generate
 
-    def stop(self) -> None:
-        """Shut down the continuous-batching engine, if one was started."""
+    def stop(self, drain: bool = False) -> None:
+        """Shut down the continuous-batching engine, if one was started.
+        `drain=True` lets in-flight engine requests finish first, bounded
+        by this predictor's `drain_timeout_s` — the runner's stop() path
+        uses it so a scale-down or rolling replica replacement never
+        kills a request that was already decoding."""
         if self.engine is not None:
-            self.engine.stop()
+            self.engine.stop(drain=drain,
+                             drain_timeout_s=self.drain_timeout_s)
 
-    def _predict(self, input_json: dict) -> tuple[dict, tuple]:
+    # ------------------------------------------------------ fleet surface
+    @property
+    def model_version(self) -> int:
+        """The adapter version this replica serves (monotonic; bumped by
+        swap_adapters). The engine's counter when one runs — the
+        per-request degrade path swaps in lockstep, so the version is
+        honest on both paths."""
+        return (self.engine.model_version if self.engine is not None
+                else self._version)
+
+    def swap_adapters(self, adapters: Pytree,
+                      version: Optional[int] = None) -> int:
+        """Hot-swap the LoRA adapter values this predictor serves — the
+        rolling-update primitive (serving/engine.py swap_adapters has the
+        atomicity story). The per-request fallback path swaps in the SAME
+        call, so an engine that later dies degrades to a path serving the
+        same version, not stale weights. Returns the new model_version."""
+        if not self.kv_cache:
+            raise ValueError(
+                "adapter hot swap needs kv_cache=True — the recompute "
+                "path serves pre-merged params (llm.lora.lora_merge); "
+                "redeploy the replica instead")
+        if self.adapters is None:
+            raise ValueError(
+                "this predictor was built without adapters — hot swap "
+                "replaces adapter VALUES only; deploy with adapters "
+                "(zero-initialized LoRA serves the base model exactly)")
+        if self.engine is not None:
+            ver = self.engine.swap_adapters(adapters, version=version)
+            # the degrade path must serve the same weights the engine does
+            self.adapters = self.engine.adapters
+            self._version = ver
+            return ver
+        from .engine import prepare_adapter_swap
+
+        stacked, ver = prepare_adapter_swap(
+            self.adapters, adapters, self.model.n_layers,
+            self._version, version, who="this replica")
+        with recorder.span("serving.swap", version=ver):
+            self.adapters = stacked
+            self._version = ver
+        _mx.set_gauge("serving.model_version", ver)
+        # the serving tier's ONE swap counter (top's fleet line reads
+        # it): engine-backed and degraded-path swaps both count
+        _mx.inc("serving.engine.swaps")
+        return ver
+
+    def _check_pin(self, input_json: dict) -> None:
+        """Per-request version pinning: a request naming `model_version`
+        is answered ONLY by a replica serving exactly that version — the
+        contract that lets the gateway keep a mixed-version fleet honest
+        mid-rolling-update (a 409 reroutes to a sibling; it never kills
+        the replica)."""
+        pin = input_json.get("model_version")
+        if pin is None:
+            return
+        try:
+            pin = int(pin)
+        except (TypeError, ValueError):
+            raise InvalidRequest(
+                f"model_version must be an integer; got {pin!r}") from None
+        if pin != self.model_version:
+            raise StaleVersion(
+                f"request pinned model_version {pin}; this replica "
+                f"serves {self.model_version}")
+
+    def _parse_request(self, input_json: dict, batched: bool
+                       ) -> tuple[list, float, list, int]:
+        """The validation contract /predict and its streaming form MUST
+        share (one helper so the two paths can't drift): integer tokens,
+        numeric sampling knobs, non-empty rows, sampling-needs-kv_cache,
+        and knob/temperature consistency. Returns (rows, temperature,
+        knobs, max_new_tokens)."""
         raw = input_json["tokens"]
-        # {"tokens": [[...], [...]]} = a BATCH of prompts decoded in
-        # lockstep through one program (kv_cache only; rows may differ in
-        # length); {"tokens": [...]} = one prompt
-        batched = bool(raw) and isinstance(raw[0], (list, tuple))
         try:
             rows = [[int(t) for t in r]
                     for r in (raw if batched else [raw])]
@@ -375,12 +472,6 @@ class GreedyLMPredictor(_InstrumentedPredictor):
             raise InvalidRequest(
                 "tokens must contain at least one prompt token"
                 " (per row, for a batch)")
-        if batched and not self.kv_cache:
-            raise InvalidRequest(
-                "batched prompts need kv_cache=True (the recompute path "
-                "decodes one prompt per program)")
-        toks = max(rows, key=len)     # longest row drives capacity checks
-        new = _req_int(input_json, "max_new_tokens", 16)
         # a knob at its documented disabled default (top_k=0, seed=0) is
         # equivalent to omitting it — client SDKs that serialize defaults
         # must not be rejected on greedy requests
@@ -393,6 +484,42 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 f"{'/'.join(knobs)} only apply when temperature > 0 "
                 "(temperature omitted or 0 means greedy decoding — the "
                 "knobs would be silently ignored)")
+        return (rows, temperature, knobs,
+                _req_int(input_json, "max_new_tokens", 16))
+
+    def _must_surface_engine_failure(self, prompt_len: int, new: int,
+                                     temperature: float,
+                                     seed: Optional[int]) -> bool:
+        """Degrade contract, shared by both paths: True when the
+        per-request fallback could NOT honor what the engine promised, so
+        an engine failure must surface (500 -> gateway failover) instead
+        of silently degrading:
+        - seeded sampling: the per-request rng schedule differs, same
+          seed would return different tokens with no signal
+        - engine_eos_id: the per-request path has no eos support,
+          degraded output would include post-eos tokens
+        - engine-only capacity: prompt + bucket(max_new) over max_len
+          would turn a previously-valid request into a permanent,
+          misleading 400"""
+        return ((temperature > 0 and seed is not None)
+                or self.eos_id is not None
+                or prompt_len + _bucket(max(new, 1), pow2_cap=self.max_len)
+                > self.max_len)
+
+    def _predict(self, input_json: dict) -> tuple[dict, tuple]:
+        self._check_pin(input_json)
+        raw = input_json["tokens"]
+        # {"tokens": [[...], [...]]} = a BATCH of prompts decoded in
+        # lockstep through one program (kv_cache only; rows may differ in
+        # length); {"tokens": [...]} = one prompt
+        batched = bool(raw) and isinstance(raw[0], (list, tuple))
+        rows, temperature, knobs, new = self._parse_request(
+            input_json, batched)
+        if batched and not self.kv_cache:
+            raise InvalidRequest(
+                "batched prompts need kv_cache=True (the recompute path "
+                "decodes one prompt per program)")
+        toks = max(rows, key=len)     # longest row drives capacity checks
         # continuous-batching route (serving/engine.py): single prompts
         # without a top_k cutoff stream through the slot engine — the
         # request blocks on its ticket while OTHER requests decode in the
@@ -435,19 +562,10 @@ class GreedyLMPredictor(_InstrumentedPredictor):
             except RuntimeError:
                 # Degrade ONLY when the per-request path honors the same
                 # contract the engine did; otherwise surface the failure
-                # (a 500; the gateway fails the replica over):
-                # - seeded sampling: the per-request rng schedule differs,
-                #   same seed would return different tokens with no signal
-                # - engine_eos_id: the per-request path has no eos support,
-                #   degraded output would include post-eos tokens
-                # - engine-only capacity: prompt + bucket(max_new) over
-                #   max_len would turn a previously-valid request into a
-                #   permanent, misleading 400
-                if ((temperature > 0 and seed is not None)
-                        or self.eos_id is not None
-                        or len(rows[0]) + _bucket(max(new, 1),
-                                                  pow2_cap=self.max_len)
-                        > self.max_len):
+                # (a 500; the gateway fails the replica over) — the
+                # shared _must_surface_engine_failure predicate
+                if self._must_surface_engine_failure(
+                        len(rows[0]), new, temperature, seed):
                     raise
             if gen is not None:
                 out = {"generated_tokens": gen}
@@ -565,3 +683,75 @@ class GreedyLMPredictor(_InstrumentedPredictor):
             if self.detokenize is not None:
                 out["generated_text"] = self.detokenize(gen)
         return out, key
+
+    # ---------------------------------------------------------- streaming
+    def predict_stream(self, input_json: dict):
+        """Generator form of predict() for single-prompt requests: yields
+        one {"token": t, "index": i} per generated token, then a final
+        {"done": True, "generated_tokens": [...]} (plus generated_text
+        with a detokenizer) — the payload the runner's SSE surface
+        relays chunk by chunk.
+
+        Engine-backed predictors stream LIVE: tokens surface as the
+        engine's retirement frames land (granularity = fetch_chunk), so
+        time-to-first-token is an engine iteration, not the whole
+        request. Requests the engine can't take (top_k, page budget,
+        dead engine within the degrade contract) compute through
+        predict() in one program and then emit — degenerate timing,
+        identical payload contract. Greedy streams are deterministic:
+        re-running the same request yields the same token sequence,
+        which is what lets the gateway re-serve a cut stream from token
+        0 on a survivor replica."""
+        self._check_pin(input_json)
+        raw = input_json["tokens"]
+        if raw and isinstance(raw[0], (list, tuple)):
+            raise InvalidRequest(
+                "streaming serves one prompt per request (batched rows "
+                "return a single response; use /predict without stream)")
+        rows_w, temperature, knobs, new = self._parse_request(
+            input_json, batched=False)
+        rows = rows_w[0]
+        top_k = int(input_json.get("top_k", 0) or 0)
+        pin = input_json.get("model_version")
+        pin = int(pin) if pin is not None else None   # _check_pin validated
+        ticket = None
+        if (self.engine is not None and top_k == 0
+                and self.engine.admissible(len(rows), max(new, 1))):
+            seed = int(input_json["seed"]) if "seed" in input_json else None
+            try:
+                ticket = self.engine.submit(
+                    rows, max(new, 1), temperature=temperature, seed=seed)
+            except RuntimeError:
+                # same degrade contract as predict(): greedy/unseeded
+                # falls through to the one-shot path below
+                if self._must_surface_engine_failure(
+                        len(rows), new, temperature, seed):
+                    raise
+        if ticket is not None:
+            _mx.inc("serving.stream_requests")
+            out: list[int] = []
+            for tok in ticket.stream(timeout=600.0):
+                # a hot swap that lands mid-stream finishes this slot on
+                # the NEW adapters — a pinned stream must fail (terminal
+                # error event; the gateway reroutes/replays) rather than
+                # silently splice model versions
+                if pin is not None and self.model_version != pin:
+                    raise StaleVersion(
+                        f"request pinned model_version {pin}; this "
+                        f"replica swapped to {self.model_version} "
+                        "mid-stream")
+                if len(out) >= new:
+                    break       # new == 0: the engine still decoded one
+                out.append(int(tok))
+                yield {"token": int(tok), "index": len(out) - 1}
+            final = {"done": True, "generated_tokens": out}
+            if self.detokenize is not None:
+                final["generated_text"] = self.detokenize(out)
+            yield final
+            return
+        res = self.predict(dict(input_json))
+        gen = res["generated_tokens"]
+        _mx.inc("serving.stream_requests")
+        for i, t in enumerate(gen):
+            yield {"token": int(t), "index": i}
+        yield {"done": True, **res}

@@ -47,6 +47,40 @@ def client_name(round_idx: int, client_rank: int) -> str:
     return f"client_{int(client_rank)}/round_{int(round_idx):06d}"
 
 
+def adapter_name(round_idx: int) -> str:
+    """Round-N LoRA adapters — the hot-swap payload the serving fleet's
+    rolling updater fetches (serving/scheduler.py Deployment.rolling_update
+    → each replica's /swap endpoint)."""
+    return f"adapters/round_{int(round_idx):06d}"
+
+
+def store_spec(store) -> dict:
+    """Serialize a store HANDLE (not its contents) for the wire — the
+    /swap request body names the store + artifact and each replica fetches
+    the adapters itself, so a rolling update never pushes tensor payloads
+    through the gateway's JSON plane."""
+    if isinstance(store, FileArtifactStore):
+        return {"kind": "file", "root": str(store.root)}
+    if isinstance(store, BrokerArtifactStore):
+        return {"kind": "broker", "broker_id": store.broker_id,
+                "run_id": store.run_id}
+    raise TypeError(f"not an artifact store: {type(store).__name__}")
+
+
+def store_from_spec(spec: dict):
+    """Rebuild a store handle from `store_spec` output. File stores need
+    a shared filesystem (the single-host shape); broker stores rendezvous
+    on the broker id and work cross-process."""
+    kind = spec.get("kind")
+    if kind == "file":
+        return FileArtifactStore(spec["root"])
+    if kind == "broker":
+        return BrokerArtifactStore(spec.get("broker_id", "default"),
+                                   spec.get("run_id", "default"))
+    raise ValueError(f"unknown artifact store kind {kind!r} "
+                     "(expected 'file' or 'broker')")
+
+
 class FileArtifactStore:
     """Directory-backed store: one codec blob per artifact name."""
 
@@ -111,6 +145,7 @@ class BrokerArtifactStore:
         from ..comm.broker import get_cas_broker
 
         self.broker = get_cas_broker(broker_id)
+        self.broker_id = broker_id
         self.run_id = run_id
         self.keep_rounds = keep_rounds
         with BrokerArtifactStore._locks_guard:

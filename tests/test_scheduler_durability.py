@@ -146,7 +146,10 @@ def _serving_cluster(n_workers=2):
                           "bias": np.zeros(3, np.float32)}}
     spec = {"model": "lr", "num_classes": 3, "params": params,
             "requirements": {"tags": ["serve"]}}
-    dep = Deployment(master, spec, min_replicas=2, max_replicas=3)
+    # short probation: a killed replica's SUSPECT window resolves to DEAD
+    # within the test's patience instead of the operator-scale default
+    dep = Deployment(master, spec, min_replicas=2, max_replicas=3,
+                     probation_deadline_s=1.5, probe_backoff_s=0.05)
     return run_id, master, workers, dep
 
 
@@ -177,8 +180,15 @@ def test_deploy_gateway_failover_e2e():
         for _ in range(4):
             out = _post(url + "/predict", {"inputs": x})
             assert "predictions" in out, out
-        assert any(r.state == "DEAD" and r.replica_id == victim
-                   for r in dep.replicas)
+        # probation (ISSUE 9): the victim is SUSPECT first; its /ready
+        # never answers again, so the probation deadline declares it DEAD
+        assert any(r.state in ("SUSPECT", "DEAD")
+                   and r.replica_id == victim for r in dep.replicas)
+        deadline = time.monotonic() + 10
+        while not any(r.state == "DEAD" and r.replica_id == victim
+                      for r in dep.replicas):
+            assert time.monotonic() < deadline, "probation never gave up"
+            time.sleep(0.05)
         gw.stop()
     finally:
         master.stop()
@@ -220,31 +230,39 @@ class _CodeHandler:
 
 
 class _StubDep:
-    """Duck-typed Deployment: deterministic pick (first READY), counts
-    heals."""
+    """Duck-typed Deployment: deterministic acquire (first READY),
+    records suspects instead of running real probation."""
 
     def __init__(self, reps):
         self.reps = reps
-        self.healed = 0
+        self.suspected = 0
 
     def ready_replicas(self):
         return [r for r in self.reps if r.state == "READY"]
 
-    def pick(self):
-        ready = self.ready_replicas()
+    def acquire(self, exclude=None):
+        ready = [r for r in self.ready_replicas()
+                 if not exclude or r.replica_id not in exclude]
+        if ready:
+            ready[0].inflight += 1
         return ready[0] if ready else None
 
-    def mark_dead(self, rep):
-        rep.state = "DEAD"
+    def release(self, rep):
+        rep.inflight -= 1
+
+    def mark_suspect(self, rep):
+        rep.state = "SUSPECT"
+        self.suspected += 1
 
     def reap_and_heal(self):
-        self.healed += 1
+        pass
 
 
-def test_gateway_4xx_keeps_replica_5xx_fails_over_with_backoff():
-    """Failover policy (ISSUE 5 satellite): a client-side 4xx must NOT
-    kill a healthy replica; a 5xx marks it DEAD and the request retries
-    elsewhere — after a short backoff, not immediately."""
+def test_gateway_4xx_keeps_replica_5xx_suspects_with_backoff():
+    """Failover policy (ISSUE 5, probation since ISSUE 9): a client-side
+    4xx must NOT pull a healthy replica from rotation; a 5xx sends it to
+    PROBATION (suspect) and the request retries elsewhere — after a
+    short backoff, not immediately."""
     from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
 
     servers = [_CodeHandler(500), _CodeHandler(400), _CodeHandler(200)]
@@ -257,29 +275,397 @@ def test_gateway_4xx_keeps_replica_5xx_fails_over_with_backoff():
         reps.append(r)
     bad5, bad4, good = reps
     try:
-        # 4xx: surfaced to the caller, replica stays READY, no heal
+        # 4xx: surfaced to the caller, replica stays READY, not suspected
         dep = _StubDep([bad4, good])
         gw = InferenceGateway(dep, retry_backoff_s=0.1)
         code, payload = gw._forward(b"{}", tries=3)
         assert code == 400 and payload == {"code": 400}
-        assert bad4.state == "READY" and dep.healed == 0
+        assert bad4.state == "READY" and dep.suspected == 0
         gw._server.server_close()
 
-        # 5xx: replica dies, request fails over to the survivor — and the
-        # second attempt waited for the backoff
+        # 5xx: replica goes to probation, request fails over to the
+        # survivor — and the second attempt waited for the backoff
         dep = _StubDep([bad5, good])
         gw = InferenceGateway(dep, retry_backoff_s=0.1)
         t0 = time.monotonic()
         code, payload = gw._forward(b"{}", tries=3)
         elapsed = time.monotonic() - t0
         assert code == 200 and payload == {"code": 200}
-        assert bad5.state == "DEAD" and dep.healed == 1
+        assert bad5.state == "SUSPECT" and dep.suspected == 1
         assert good.state == "READY"
         assert elapsed >= 0.09, f"no backoff between attempts ({elapsed})"
+        # load accounting balanced: nothing left acquired
+        assert bad5.inflight == 0 and good.inflight == 0
         gw._server.server_close()
     finally:
         for s in servers:
             s.stop()
+
+
+def test_gateway_409_reroute_excludes_stale_replica():
+    """Version-pin reroute (ISSUE 9): a replica that 409'd this request's
+    pin is EXCLUDED from the retry pick — an idle stale replica would
+    otherwise win least-loaded/first-ready on every attempt and the
+    gateway would surface 409 despite a sibling serving the pinned
+    version. Neither replica is suspected (both are healthy)."""
+    from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
+
+    servers = [_CodeHandler(409), _CodeHandler(200)]
+    reps = []
+    for i, s in enumerate(servers):
+        r = _Replica(f"job{i}")
+        r.replica_id = f"rep{i}"
+        r.endpoint = f"http://127.0.0.1:{s.port}"
+        r.state = "READY"
+        reps.append(r)
+    try:
+        dep = _StubDep(reps)      # first-ready: always the 409 replica
+        gw = InferenceGateway(dep, retry_backoff_s=0.01)
+        code, payload = gw._forward(b"{}", tries=3)
+        assert code == 200 and payload == {"code": 200}
+        assert dep.suspected == 0
+        assert all(r.inflight == 0 for r in reps)
+        gw._server.server_close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+class _SSEReplica:
+    """Tiny replica whose /predict streams token events then done."""
+
+    def __init__(self, n_tokens: int = 3):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                for i in range(n_tokens):
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"token": 7, "index": i}).encode() + b"\n\n")
+                self.wfile.write(b'data: {"done": true}\n\n')
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_stream_client_disconnect_does_not_suspect_replica():
+    """A DOWNSTREAM client hanging up mid-SSE raises from the gateway's
+    relay write — that is not a replica failure: the relay must abort
+    without suspecting the (healthy) replica or burning retries on a
+    socket nobody reads (ISSUE 9 review fix)."""
+    from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
+
+    sse = _SSEReplica(n_tokens=3)
+    rep = _Replica("job0")
+    rep.replica_id = "rep0"
+    rep.endpoint = f"http://127.0.0.1:{sse.port}"
+    rep.state = "READY"
+
+    class _DeadClientHandler:
+        """Duck-typed BaseHTTPRequestHandler whose socket is gone: the
+        first body write raises BrokenPipeError."""
+
+        def __init__(self):
+            outer = self
+
+            class _W:
+                def write(self, data):
+                    raise BrokenPipeError("client went away")
+
+                def flush(self):
+                    pass
+
+            self.wfile = _W()
+            self.sent: list = []
+            self._outer = outer
+
+        def send_response(self, code):
+            self.sent.append(code)
+
+        def send_header(self, *a):
+            pass
+
+        def end_headers(self):
+            pass
+
+        def _send(self, code, payload, extra_headers=None):
+            self.sent.append(code)
+
+    try:
+        dep = _StubDep([rep])
+        gw = InferenceGateway(dep, retry_backoff_s=0.01)
+        handler = _DeadClientHandler()
+        gw.forward_stream(b'{"stream": true}', handler, tries=3)
+        assert dep.suspected == 0, "healthy replica was suspected for a " \
+                                   "client-side disconnect"
+        assert rep.state == "READY"
+        assert rep.inflight == 0
+        gw._server.server_close()
+    finally:
+        sse.stop()
+
+
+class _SwapStubReplica:
+    """Stub replica speaking the fleet-control surface: /ready, /info
+    (current model_version), /swap (records accepted versions, enforcing
+    the engine's monotonic-version guard with a 400)."""
+
+    def __init__(self, model_version: int = 1):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+        self.model_version = model_version
+        self.swaps: list = []
+        self.on_swap = None
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    self._send(200, {"status": "Success"})
+                else:
+                    self._send(200,
+                               {"model_version": outer.model_version})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                ver = int(body.get("version", -1))
+                if ver < outer.model_version:
+                    self._send(400, {"error": "model_version must be "
+                                              "monotonic"})
+                    return
+                outer.swaps.append(ver)
+                outer.model_version = ver
+                if outer.on_swap is not None:
+                    outer.on_swap()
+                self._send(200, {"model_version": ver})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_probation_converges_replica_ahead_of_target():
+    """A replica AHEAD of the recorded fleet target (a newer rolling
+    update already reached it; the recorded target lags until a walk
+    completes) must recover from probation — not be re-driven backwards
+    into the engine's monotonic-swap 400 until the probation deadline
+    kills a healthy replica (ISSUE 9 review fix)."""
+    from fedml_tpu.serving.scheduler import Deployment
+
+    stub = _SwapStubReplica(model_version=2)
+    try:
+        dep = Deployment.adopt([f"http://127.0.0.1:{stub.port}"],
+                               probation_deadline_s=3.0,
+                               probe_backoff_s=0.02)
+        rep = dep.replicas[0]
+        dep._adapter_target = (b"{}", 1)      # stale record: fleet at v1
+        dep.mark_suspect(rep)
+        deadline = time.monotonic() + 2.5
+        while rep.state != "READY" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rep.state == "READY", \
+            "up-to-date replica failed probation against a stale target"
+        assert stub.swaps == [], "replica was re-driven backwards"
+    finally:
+        stub.stop()
+
+
+def test_rolling_update_sweeps_probation_rejoiner(tmp_path):
+    """A replica that rejoins from probation WHILE rolling_update walks
+    the fleet converged against the PREVIOUS target and the walk's entry
+    snapshot never saw it — without the post-walk sweep it would serve
+    stale weights forever behind a fleet gauge claiming otherwise
+    (ISSUE 9 review fix)."""
+    from fedml_tpu.serving.scheduler import Deployment
+    from fedml_tpu.utils.artifacts import FileArtifactStore
+
+    a = _SwapStubReplica(model_version=1)
+    b = _SwapStubReplica(model_version=1)
+    try:
+        dep = Deployment.adopt([f"http://127.0.0.1:{a.port}",
+                                f"http://127.0.0.1:{b.port}"])
+        rep_b = dep.replicas[1]
+        rep_b.state = "SUSPECT"     # out of rotation when the walk starts
+        # B "recovers" the moment A takes its swap: READY mid-walk, on v1
+        a.on_swap = lambda: setattr(rep_b, "state", "READY")
+        store = FileArtifactStore(str(tmp_path))
+        dep.rolling_update(store, "adapters-v2", version=2, timeout=10)
+        assert b.swaps == [2], "mid-walk rejoiner was never swept to v2"
+        assert rep_b.model_version == 2
+        assert b.model_version == 2
+    finally:
+        a.stop()
+        b.stop()
+
+
+class _CaptureHandler:
+    """Duck-typed downstream handler capturing everything the gateway
+    relays (the working-socket counterpart of _DeadClientHandler)."""
+
+    def __init__(self):
+        outer = self
+        self.sent: list = []
+        self.body = b""
+
+        class _W:
+            def write(self, data):
+                outer.body += data
+
+            def flush(self):
+                pass
+
+        self.wfile = _W()
+
+    def send_response(self, code):
+        self.sent.append(code)
+
+    def send_header(self, *a):
+        pass
+
+    def end_headers(self):
+        pass
+
+    def _send(self, code, payload, headers=None):
+        self.sent.append(code)
+        self.body += json.dumps(payload).encode()
+
+
+class _SSE409Replica:
+    """Streams one token event then a terminal 409-coded error event —
+    the runner's pinned-stream-straddled-a-hot-swap shape."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                self.wfile.write(
+                    b'data: {"token": 7, "index": 0}\n\n')
+                self.wfile.write(
+                    b'data: {"error": "StaleVersion: pinned 1", '
+                    b'"code": 409}\n\n')
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_stream_mid_409_event_reroutes_without_suspect():
+    """A pinned stream that straddles a hot swap gets a terminal
+    409-coded error event — the replica is HEALTHY: the gateway must
+    reroute to a sibling (replaying the relayed prefix with the
+    dedupe-verify machinery) instead of suspecting it and draining
+    ready capacity during every update window (ISSUE 9 review fix)."""
+    from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
+
+    stale = _SSE409Replica()
+    full = _SSEReplica(n_tokens=3)
+    reps = []
+    for i, s in enumerate((stale, full)):
+        r = _Replica(f"job{i}")
+        r.replica_id = f"rep{i}"
+        r.endpoint = f"http://127.0.0.1:{s.port}"
+        r.state = "READY"
+        reps.append(r)
+    try:
+        dep = _StubDep(reps)      # first-ready: the stale replica
+        gw = InferenceGateway(dep, retry_backoff_s=0.01)
+        handler = _CaptureHandler()
+        gw.forward_stream(b'{"stream": true, "model_version": 1}',
+                          handler, tries=3)
+        assert dep.suspected == 0, \
+            "healthy replica suspected for a mid-stream version pin"
+        assert b'"done": true' in handler.body
+        # the full stream reached the client exactly once: the sibling's
+        # replayed token 0 was deduped, not duplicated
+        assert handler.body.count(b'"token"') == 3
+        assert all(r.inflight == 0 for r in reps)
+        gw._server.server_close()
+    finally:
+        stale.stop()
+        full.stop()
+
+
+def test_sampled_stream_cut_before_first_byte_fails_over():
+    """A sampled (non-replayable) stream whose replica dies BEFORE any
+    byte reached the client is safely retried on a survivor — nothing
+    was relayed, so there is nothing to splice; only a cut after bytes
+    went out must surface the terminal 503 (ISSUE 9 review fix)."""
+    import socket
+
+    from fedml_tpu.serving.scheduler import InferenceGateway, _Replica
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()                     # nothing listens here: instant refusal
+    full = _SSEReplica(n_tokens=2)
+    dead = _Replica("job0")
+    dead.replica_id = "rep0"
+    dead.endpoint = f"http://127.0.0.1:{dead_port}"
+    dead.state = "READY"
+    live = _Replica("job1")
+    live.replica_id = "rep1"
+    live.endpoint = f"http://127.0.0.1:{full.port}"
+    live.state = "READY"
+    try:
+        dep = _StubDep([dead, live])   # first-ready: the dead endpoint
+        gw = InferenceGateway(dep, retry_backoff_s=0.01)
+        handler = _CaptureHandler()
+        gw.forward_stream(b'{"stream": true, "temperature": 1.0}',
+                          handler, tries=3)
+        assert dead.state == "SUSPECT" and dep.suspected == 1
+        assert live.state == "READY"
+        assert b'"done": true' in handler.body, \
+            "pre-byte sampled cut was surfaced instead of retried"
+        gw._server.server_close()
+    finally:
+        full.stop()
 
 
 def test_autoscaler_scales_up_under_load():
