@@ -451,6 +451,94 @@ def bench_reliable_comm() -> dict:
     }
 
 
+def bench_cross_silo_durability(quick: bool = False) -> dict:
+    """Cross-silo durability rows (ISSUE 10).
+
+    (a) Recovery after server SIGKILL: a 4-round loopback federation's
+    server is severed after 2 completed rounds and restarted with resume —
+    `cross_silo_recovery_s` is restart→run-complete wall time (checkpoint
+    load + client re-attach + the 2 remaining rounds) and
+    `cross_silo_recovery_bitwise` pins that the final params equal the
+    uninterrupted run's.
+
+    (b) Eviction saves the round_timeout stall: a 3-client federation with
+    one permanently dead client, run once WITHOUT liveness (every round
+    drafts the dead client and pays the full `round_timeout` before closing
+    on quorum) and once WITH liveness eviction (the dead client leaves the
+    selection pool after its miss budget). The bar: eviction must recover
+    ≥ 80% of a full round_timeout per steady-state round (the residual is
+    the real round's work)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.cross_silo.soak import (
+        SiloSoakHarness, server_kill_restart_soak,
+        uninterrupted_final_params,
+    )
+
+    # ---- (a) recovery time + bitwise pin
+    ref, _hist = uninterrupted_final_params(n_clients=2, rounds=4)
+    with tempfile.TemporaryDirectory() as d:
+        out = server_kill_restart_soak(d, n_clients=2, rounds=4,
+                                       kill_after=2)
+    bitwise = all(jax.tree.leaves(jax.tree.map(
+        lambda a, b: bool(np.array_equal(a, b)), ref, out["params"])))
+
+    # ---- (b) eviction vs round_timeout stalls. The dead client completes
+    # the init handshake and round 0, then dies (an absent client would
+    # block init itself — without liveness that wait is unbounded, the
+    # reference behavior). Every later round that still drafts it stalls a
+    # full round_timeout before closing on quorum; with liveness eviction
+    # the client leaves the pool after its miss budget and the stalls stop.
+    round_timeout = 0.4 if quick else 0.8
+    rounds = 4 if quick else 6
+
+    def dead_client_run(liveness):
+        h = SiloSoakHarness(
+            n_clients=3, rounds=rounds,
+            server_kw=dict(round_timeout=round_timeout, quorum_frac=0.5,
+                           liveness_timeout_s=(1.2 * round_timeout
+                                               if liveness else None)))
+        try:
+            h.start_server()
+            for cid in (1, 2, 3):
+                h.start_client(cid, heartbeat_s=round_timeout / 4)
+            if not h.wait_history(1, timeout=60):
+                raise TimeoutError("round 0 never completed")
+            h.kill_client(3)
+            t0 = time.perf_counter()
+            if not h.wait_done(timeout=120):
+                raise TimeoutError("dead-client federation did not finish")
+            hist = list(h.server.history)
+            stalls = len([1 for r in hist if r["n_received"] < 3])
+            return time.perf_counter() - t0, stalls
+        finally:
+            h.close()
+
+    t_off, stalls_off = dead_client_run(False)
+    t_on, stalls_on = dead_client_run(True)
+    # each avoided stall is one round that no longer waits out the full
+    # round_timeout; normalize the wall-clock win per avoided stall so the
+    # in-process kill race (a mid-train kill still delivers one last
+    # result) cannot skew the per-round figure
+    avoided = max(stalls_off - stalls_on, 1)
+    saved_per_round = max(t_off - t_on, 0.0) / avoided
+    return {
+        "cross_silo_recovery_s": round(out["recovery_s"], 3),
+        "cross_silo_recovery_rounds": len(out["history"]),
+        "cross_silo_recovery_bitwise": bool(bitwise),
+        "cross_silo_evict_saved_s_per_round": round(saved_per_round, 3),
+        "cross_silo_evict_bar_s": round(0.8 * round_timeout, 3),
+        "cross_silo_evict_round_timeout_s": round_timeout,
+        "cross_silo_evict_total_s_no_liveness": round(t_off, 3),
+        "cross_silo_evict_total_s_liveness": round(t_on, 3),
+        "cross_silo_evict_stalled_rounds_no_liveness": stalls_off,
+        "cross_silo_evict_stalled_rounds_liveness": stalls_on,
+    }
+
+
 def bench_serving_cb(quick: bool = False) -> dict:
     """Continuous-batching serving row (ISSUE 5): a concurrency-8
     synthetic decode workload — 8 prompts of assorted lengths, 24 new
@@ -1586,6 +1674,9 @@ _HEADLINE_KEYS = (
     "serving_fleet_accepted_p99_ms_shed",
     "serving_fleet_accepted_p99_ms_noshed",
     "serving_fleet_stream_ttft_ms",
+    # cross-silo durability (ISSUE 10): kill–restart recovery + eviction
+    "cross_silo_recovery_s", "cross_silo_recovery_bitwise",
+    "cross_silo_evict_saved_s_per_round", "cross_silo_evict_bar_s",
     # Parrot-scale cohorts (ISSUE 8): chunked/streamed rounds + cost-LPT
     "sim_scale_hbm_headroom_ratio", "sim_scale_ingest_overhead_pct",
     "sim_scale_chunked_vs_unchunked_pct",
@@ -1653,6 +1744,9 @@ def main():
                {"serving_fleet_error": "bench_serving_fleet failed twice"})
     acc.update(_retrying(bench_sim_scale, quick, default=None) or
                {"sim_scale_error": "bench_sim_scale failed twice"})
+    acc.update(_retrying(bench_cross_silo_durability, quick, default=None) or
+               {"cross_silo_durability_error":
+                "bench_cross_silo_durability failed twice"})
     if not quick:
         # fresh-interpreter subprocess (forced-2-device jax cold start +
         # two engine compiles) — too heavy for the quick lane

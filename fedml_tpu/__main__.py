@@ -417,6 +417,27 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             + (f"  fit_err {err:.2f}" if err >= 0 else "  fit_err inf")
             + f"  dispatches {int(c.get('fed_cost_model_dispatches_total', 0))}")
 
+    # --------------------------------------------- cross-silo durability
+    # (ISSUE 10: server resume / liveness eviction / rejoin / fencing)
+    if "fed_server_clients_online" in g or c.get("fed_server_resumes_total") \
+            or c.get("fed_server_checkpoints_total"):
+        seg = (f"silo: online {int(g.get('fed_server_clients_online', 0))}"
+               f"/{int(g.get('fed_server_clients_total', 0))}"
+               f"  gen {int(g.get('fed_server_generation', 0))}")
+        for label, key in (("resumes", "fed_server_resumes_total"),
+                           ("ckpts", "fed_server_checkpoints_total"),
+                           ("evicted", "fed_server_evicted_total"),
+                           ("rejoins", "fed_server_rejoins_total"),
+                           ("stale_gen",
+                            "fed_server_stale_gen_rejected_total"),
+                           ("quorum_fail",
+                            "fed_server_quorum_unreachable_total"),
+                           ("reattach", "fed_client_reattaches_total")):
+            v = int(c.get(key, 0))
+            if v:
+                seg += f"  {label} {v}"
+        lines.append(seg)
+
     # ----------------------------------------------------------------- comm
     backends = sorted({k.split("_")[1] for k in c
                        if k.startswith("comm_") and "_bytes_" in k})
@@ -1043,6 +1064,45 @@ def cmd_diagnosis(args) -> int:
         return {"resolved_params": len(_jax.tree_util.tree_leaves(specs)),
                 **mesh_child, "mode": "forced-2-device subprocess"}
 
+    def cross_silo_durability_smoke():
+        # the crash-durability plane end-to-end (ISSUE 10): an in-process
+        # loopback federation whose server is SIGKILL-severed mid-run (no
+        # farewell, no checkpoint flush, stale frames left in flight) and
+        # restarted with `resume` — the run must complete (the resumed
+        # server initiates the re-handshake; the client watchdog is the
+        # slow-restart backstop) and the final full-participation params
+        # must be BITWISE-equal to an uninterrupted run's. Budget-lean:
+        # two 3-round lr federations sharing one jit cache.
+        import tempfile
+
+        import jax as _jax
+        import numpy as _np
+
+        from .cross_silo.soak import (
+            server_kill_restart_soak, uninterrupted_final_params,
+        )
+
+        ref, _hist = uninterrupted_final_params(n_clients=2, rounds=3)
+        with tempfile.TemporaryDirectory() as d:
+            out = server_kill_restart_soak(d, n_clients=2, rounds=3,
+                                           kill_after=1)
+        if out["error"]:
+            raise RuntimeError(f"resumed run failed: {out['error']}")
+        if [h["round"] for h in out["history"]] != [0, 1, 2]:
+            raise ValueError(f"resumed history malformed: {out['history']}")
+        eq = all(_jax.tree.leaves(_jax.tree.map(
+            lambda a, b: bool(_np.array_equal(a, b)), ref, out["params"])))
+        if not eq:
+            raise ValueError("resumed final params differ bitwise from the "
+                             "uninterrupted run")
+        if out["resumes"] < 1:
+            raise ValueError("server never recorded a resume")
+        return {"rounds": len(out["history"]),
+                "recovery_s": round(out["recovery_s"], 3),
+                "resumes": out["resumes"],
+                "stale_gen_rejected": out["stale_gen_rejected"],
+                "generation": out["generation"]}
+
     def cohort_sharded_smoke():
         # the Parrot-scale simulation plane end-to-end (ISSUE 8): a
         # chunked+streamed cohort round over a REAL multi-device mesh ==
@@ -1069,11 +1129,13 @@ def cmd_diagnosis(args) -> int:
               "serving_paged_smoke": serving_paged_smoke,
               "fleet_rolling_update_smoke": fleet_rolling_update_smoke,
               "partition_rules_smoke": partition_rules_smoke,
-              "cohort_sharded_smoke": cohort_sharded_smoke}
+              "cohort_sharded_smoke": cohort_sharded_smoke,
+              "cross_silo_durability_smoke": cross_silo_durability_smoke}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
                 "fleet_rolling_update_smoke",
-                "partition_rules_smoke", "cohort_sharded_smoke")
+                "partition_rules_smoke", "cohort_sharded_smoke",
+                "cross_silo_durability_smoke")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)

@@ -75,6 +75,15 @@ class FaultSpec:
                          spec at construction; deterministic like every
                          other schedule here, so a mid-stream failover
                          test replays exactly.
+      silo_kill        — {rank: round}: the cross-silo PROCESS-death
+                         schedule (ISSUE 10) — rank 0 (the server) or a
+                         client rank is SIGKILL-severed once the run has
+                         completed `round` rounds, then restarted (the
+                         server with `resume`). Consumed by
+                         cross_silo/soak.py's kill–restart soak driver;
+                         `crash`/`flap` above model the LINK dying while
+                         the process lives, this models the process dying
+                         while the link state (unread frames) survives.
     """
 
     seed: int = 0
@@ -89,6 +98,7 @@ class FaultSpec:
     client_dropout: float = 0.0
     client_straggler: float = 0.0
     replica_kill: dict = dataclasses.field(default_factory=dict)
+    silo_kill: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         for f in _PROB_FIELDS + ("client_dropout", "client_straggler"):
@@ -108,13 +118,15 @@ class FaultSpec:
                 f"common_args.extra.chaos.seed must be an int; got "
                 f"{self.seed!r}")
         for name, sched in (("crash", self.crash), ("flap", self.flap),
-                            ("replica_kill", self.replica_kill)):
+                            ("replica_kill", self.replica_kill),
+                            ("silo_kill", self.silo_kill)):
             if not isinstance(sched, dict):
                 raise ValueError(
                     f"common_args.extra.chaos.{name} must be a dict keyed by "
                     f"rank; got {sched!r}")
         for sched_name, sched in (("crash", self.crash),
-                                  ("replica_kill", self.replica_kill)):
+                                  ("replica_kill", self.replica_kill),
+                                  ("silo_kill", self.silo_kill)):
             # replica_kill fires AFTER the n-th streamed token, so 0 would
             # silently behave as 1 — refuse it (kill-before-first-byte is
             # a listening-socket kill, not a mid-stream schedule)
@@ -159,7 +171,7 @@ class FaultSpec:
         # YAML keys arrive as strings; crash/flap/replica_kill schedules
         # are rank-keyed
         norm = dict(d)
-        for sched in ("crash", "flap", "replica_kill"):
+        for sched in ("crash", "flap", "replica_kill", "silo_kill"):
             if isinstance(norm.get(sched), dict):
                 norm[sched] = {int(k): v for k, v in norm[sched].items()}
         return cls(**norm)

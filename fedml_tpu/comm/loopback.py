@@ -49,7 +49,6 @@ def release_router(run_id: str) -> None:
 
 
 class LoopbackTransport(BaseTransport):
-    _STOP = object()
     backend_name = "loopback"
 
     def __init__(self, rank: int, run_id: str = "default"):
@@ -58,6 +57,12 @@ class LoopbackTransport(BaseTransport):
         self.router = get_router(run_id)
         self._inbox = self.router.mailbox(rank)
         self._running = False
+        # per-INSTANCE stop sentinel: a restarted rank shares its dead
+        # incarnation's mailbox (that is the point — stale in-flight frames
+        # must survive, like a real process's unread sockets), so a class-
+        # level sentinel left behind by the dead instance's stop() would
+        # kill the NEW instance's receive loop on arrival (ISSUE 10)
+        self._stop_token = object()
 
     def send_message(self, msg: Message) -> None:
         frame = self._encode_frame(msg)  # exercise the wire format in-process
@@ -74,13 +79,15 @@ class LoopbackTransport(BaseTransport):
         self._running = True
         while self._running:
             item = self._inbox.get()
-            if item is self._STOP:
+            if item is self._stop_token:
                 break
+            if not isinstance(item, (bytes, bytearray)):
+                continue    # a dead incarnation's stop token — not ours
             self._notify_frame(item)
 
     def stop_receive_message(self) -> None:
         self._running = False
-        self._inbox.put(self._STOP)
+        self._inbox.put(self._stop_token)
 
 
 class JitterLoopbackTransport(LoopbackTransport):

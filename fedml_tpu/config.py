@@ -388,6 +388,60 @@ class Config:
                 "cohort_chunk: per-client health stats need the full "
                 "update stack the chunked engine exists to avoid "
                 "materializing")
+        # cross-silo durability knobs (ISSUE 10): server checkpoint/resume,
+        # client silence watchdog + heartbeats, liveness eviction, bounded
+        # quorum re-arms. Validated here so a typo'd YAML fails at load,
+        # not as a hang N rounds into a federation.
+        for knob in ("round_timeout", "heartbeat_s", "liveness_timeout_s",
+                     "server_timeout_s"):
+            val = t.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = not isinstance(val, bool) and float(val) > 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"train_args.{knob} must be a positive number of "
+                    f"seconds; got {val!r}")
+        qf = t.extra.get("quorum_frac")
+        if qf is not None:
+            try:
+                ok = not isinstance(qf, bool) and 0.0 < float(qf) <= 1.0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    "train_args.quorum_frac must be a fraction in (0, 1]; "
+                    f"got {qf!r}")
+        for knob, lo in (("max_rearms", 1), ("checkpoint_every", 0),
+                         ("checkpoint_keep", 1)):
+            val = t.extra.get(knob)
+            if val is None:
+                continue
+            try:
+                ok = (not isinstance(val, bool)
+                      and int(val) == float(val) and int(val) >= lo)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"train_args.{knob} must be an integer >= {lo}; "
+                    f"got {val!r}")
+        for knob in ("resume", "reattach"):
+            val = t.extra.get(knob)
+            if val is not None and not isinstance(val, bool):
+                raise ValueError(
+                    f"train_args.{knob} must be a boolean; got {val!r}")
+        # resume without a checkpoint_dir would be silently ignored (there
+        # is nothing to resume FROM) — refuse at load, same gating
+        # discipline as the paged-KV serve knobs
+        if t.extra.get("resume") and not t.extra.get("checkpoint_dir"):
+            raise ValueError(
+                "train_args.resume requires checkpoint_dir — resume loads "
+                "the latest checkpoint under it; without one the knob "
+                "would be silently ignored")
         # run-health export plane (utils/prometheus.py): /metrics endpoint
         # port. Validated at load so a typo'd YAML fails before a run
         # silently comes up unscrapeable.

@@ -15,6 +15,10 @@ S2C_FINISH = "s2c_finish"
 C2S_SEND_MODEL = "c2s_send_model"
 C2S_CLIENT_STATUS = "c2s_client_status"
 C2S_FINISHED = "c2s_finished"
+# liveness beacon (ISSUE 10 — no reference analog: the reference server
+# waits forever on dead clients). Lightweight, no payload beyond the
+# generation echo; the server flips client_online off after a miss budget.
+C2S_HEARTBEAT = "c2s_heartbeat"
 
 # payload keys (reference: MSG_ARG_KEY_*)
 KEY_MODEL_PARAMS = "model_params"
@@ -23,6 +27,13 @@ KEY_CLIENT_INDEX = "client_idx"
 KEY_ROUND = "round_idx"
 KEY_STATUS = "client_status"
 KEY_METRICS = "metrics"
+# run-generation (incarnation) fence (ISSUE 10): stamped on every S2C
+# training message by the server and echoed on every C2S training message.
+# A resumed server re-runs the round that was in flight when it died, so a
+# pre-restart straggler's round-ECHO can equal the live round index — the
+# transport's `_rel_epoch` fences *delivery*, not training semantics; this
+# key fences the training FSM itself.
+KEY_GENERATION = "run_gen"
 
 STATUS_ONLINE = "ONLINE"
 STATUS_FINISHED = "FINISHED"

@@ -92,7 +92,11 @@ class SecAggServerManager:
                  threshold: Optional[int] = None,
                  eval_fn: Optional[Callable[[Pytree, int], dict]] = None,
                  round_timeout: Optional[float] = None,
-                 q_bits: int = 16):
+                 q_bits: int = 16,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1,
+                 checkpoint_keep: Optional[int] = 3,
+                 resume: bool = False):
         self.comm = comm
         self.client_ids = list(client_ids)
         self.n = len(self.client_ids)
@@ -132,6 +136,11 @@ class SecAggServerManager:
         self._timer_gen = 0
         self._rearm_count = 0
         self.max_rearms = 5   # below-quorum retries before declaring failure
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.checkpoint_keep = checkpoint_keep
+        self._resumed = False
+        self._resume_kicked = False
 
         h = comm.register_message_receive_handler
         h(md.CONNECTION_IS_READY, self._on_connection_ready)
@@ -144,9 +153,23 @@ class SecAggServerManager:
         # loop, so the ack needs an explicit (no-op) handler
         h(md.C2S_FINISHED, lambda _msg: None)
 
+        if resume and checkpoint_dir is not None:
+            from ..utils.checkpoint import latest_round
+
+            if latest_round(checkpoint_dir) is not None:
+                self._restore(checkpoint_dir)
+            else:
+                log.info("resume requested but no checkpoints under %r — "
+                         "starting fresh", checkpoint_dir)
+
     # ------------------------------------------------------------ handlers
     def _on_connection_ready(self, msg: Message) -> None:
         if self.is_initialized:
+            # a restarted server's clients re-announce (client watchdog);
+            # re-run the status handshake for the sender so the resume
+            # broadcast below can fire once everyone is back
+            self.comm.send_message(
+                Message(md.S2C_CHECK_CLIENT_STATUS, 0, msg.sender_id))
             return
         for cid in self.client_ids:
             self.comm.send_message(
@@ -168,6 +191,20 @@ class SecAggServerManager:
                     m.add(md.KEY_SA_THRESHOLD, self.t)
                     m.add(md.KEY_SA_QBITS, self.q_bits)
                     self.comm.send_message(m)
+                return
+            if self._resumed and not self._resume_kicked and all(
+                    self.client_online.get(c) for c in self.active):
+                # round-boundary resume: the surviving clients still hold
+                # their key material (only the SERVER died); restart the
+                # in-flight round with a plain model sync — they re-mask
+                # with the same round_salt, deterministically
+                self._resume_kicked = True
+                for cid in sorted(self.active):
+                    m = Message(md.S2C_SYNC_MODEL, 0, cid)
+                    m.add(md.KEY_MODEL_PARAMS, self.params)
+                    m.add(md.KEY_ROUND, self.round_idx)
+                    self.comm.send_message(m)
+                self._arm_timer()
 
     def _on_pk(self, msg: Message) -> None:
         with self._lock:
@@ -379,6 +416,7 @@ class SecAggServerManager:
         recorder.log(row)
         self.masked.clear()
         self.round_idx += 1
+        self._maybe_checkpoint(self.round_idx - 1)
         if self.round_idx >= self.num_rounds:
             self._finish()
             return
@@ -388,6 +426,99 @@ class SecAggServerManager:
             m.add(md.KEY_ROUND, self.round_idx)
             self.comm.send_message(m)
         self._arm_timer()
+
+    # ---------------------------------------------------- checkpoint/restore
+    # The secagg × resume CONTRACT (ISSUE 10, README "Cross-silo
+    # durability"): restore is ROUND-BOUNDARY ONLY. A checkpoint is written
+    # exactly once per completed round, from _unmask_and_advance, after the
+    # unmask state is cleared and before the next round's syncs go out — it
+    # is NEVER written mid-secagg-round (mid-setup, mid-masked-collection,
+    # or mid-unmask), and a resume that would land inside one (a foreign or
+    # hand-crafted checkpoint claiming a non-boundary phase) is refused
+    # with a clear error. Only the SERVER may die and resume: surviving
+    # clients keep their key material and re-mask the restarted round with
+    # the same round_salt, so the resumed aggregate is deterministic.
+    def _maybe_checkpoint(self, r: int) -> None:
+        """Caller holds the lock, at a round boundary."""
+        if self.checkpoint_dir is None or not self.checkpoint_every or not (
+                (r + 1) % self.checkpoint_every == 0
+                or r == self.num_rounds - 1):
+            return
+        # invariant, not input validation: the call site above IS the round
+        # boundary — tripping this means a refactor moved the write
+        assert not self._awaiting_unmask and not self.masked, \
+            "secagg checkpoint attempted mid-round"
+        from ..utils import checkpoint as ckpt
+
+        extra = {
+            "kind": "secagg_server",
+            "phase": "boundary",
+            "threshold": self.t,
+            "q_bits": self.q_bits,
+            "num_rounds": self.num_rounds,
+            "client_ids": list(self.client_ids),
+            "pks": {str(c): int(pk) for c, pk in self.pks.items()},
+            "client_counts": {str(c): float(n)
+                              for c, n in self.client_counts.items()},
+            "weight_norm": float(self.weight_norm),
+            "active": sorted(self.active),
+            "dropped_sk": {str(c): int(sk)
+                           for c, sk in self.dropped_sk.items()},
+            "dropped_log": [[rr, list(ids)] for rr, ids in self.dropped_log],
+        }
+        try:
+            ckpt.save_checkpoint(
+                self.checkpoint_dir, r, {"params": self.params},
+                history=self.history, keep=self.checkpoint_keep, extra=extra)
+        except Exception as e:  # noqa: BLE001 — durability must not kill runs
+            log.warning("secagg round-%d checkpoint failed (continuing): "
+                        "%s: %s", r, type(e).__name__, e)
+
+    def _restore(self, path: str) -> None:
+        from ..utils import checkpoint as ckpt
+
+        # one pinned round for meta + tensors (same TOCTOU guard as the
+        # plain server: a late in-flight write must not split the pair)
+        r = ckpt.latest_round(path)
+        meta = ckpt.read_meta(path, r)
+        extra = meta.get("extra") or {}
+        if extra.get("kind") != "secagg_server":
+            raise ValueError(
+                f"refusing to resume secagg from {path!r}: checkpoint was "
+                f"written by {extra.get('kind', 'a non-secagg runtime')!r}, "
+                "and secagg restore needs the protocol state (pks, dropped "
+                "client keys, weight norm) only a secagg server writes")
+        if extra.get("phase") != "boundary":
+            raise ValueError(
+                f"refusing to resume secagg from {path!r}: checkpoint "
+                f"claims phase {extra.get('phase')!r} — secagg restore is "
+                "round-boundary only (a resume landing inside a round "
+                "cannot recover the in-flight masked uploads or unmask "
+                "shares; see README \"Cross-silo durability\")")
+        _r, server, _c, _h, hist = ckpt.restore_checkpoint(
+            path, {"params": self.params}, round_idx=r)
+        self.params = jax.tree.map(np.asarray, server["params"])
+        self.history = list(hist)
+        self.round_idx = int(meta["round"]) + 1
+        self.t = int(extra["threshold"])
+        self.q_bits = int(extra["q_bits"])
+        self.pks = {int(c): int(pk) for c, pk in extra["pks"].items()}
+        self.client_counts = {int(c): float(n)
+                              for c, n in extra["client_counts"].items()}
+        self.weight_norm = float(extra["weight_norm"])
+        self.active = set(int(c) for c in extra["active"])
+        self.dropped_sk = {int(c): int(sk)
+                           for c, sk in extra["dropped_sk"].items()}
+        self.dropped_log = [(int(rr), list(ids))
+                            for rr, ids in extra.get("dropped_log", [])]
+        self._pks_broadcast = True
+        self._route_buf = None      # setup completed before the checkpoint
+        self.client_online = {}     # liveness re-established by handshake
+        self.is_initialized = True
+        self._resumed = True
+        log.info("secagg resumed from %r: %d rounds done, continuing at "
+                 "round %d over %d active clients", path, len(self.history),
+                 self.round_idx, len(self.active))
 
     def _finish(self) -> None:
         self._cancel_timer()
@@ -402,7 +533,26 @@ class SecAggServerManager:
         threading.Thread(target=self.comm.stop, daemon=True).start()
 
     def run(self, background: bool = False) -> None:
+        if self._resumed and not self.done.is_set():
+            if self.round_idx >= self.num_rounds:
+                # checkpoint already covers the whole run: release clients
+                with self._lock:
+                    self._finish()
+            else:
+                # the resumed server INITIATES the re-handshake — secagg
+                # clients have no watchdog, so recovery cannot depend on
+                # them announcing first; their status replies trigger the
+                # resume broadcast in _on_client_status
+                for cid in sorted(self.active):
+                    self.comm.send_message(
+                        Message(md.S2C_CHECK_CLIENT_STATUS, 0, cid))
+                # bound the reconnect window like a live round: if the
+                # survivors never come back, _on_timeout's below-threshold
+                # path fails the run after max_rearms instead of hanging
+                self._arm_timer()
         self.comm.run(background=background)
+        if not background and self.error:
+            raise RuntimeError(self.error)
 
 
 class SecAggClientManager:

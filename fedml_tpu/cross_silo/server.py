@@ -15,12 +15,37 @@ server waits forever for every selected client
 is the separate async_fedavg runtime); here `round_timeout` + `quorum_frac`
 let the round close on a quorum after a deadline, and stragglers simply
 rejoin the next selection.
+
+Durability (ISSUE 10): process death is a recoverable event on both sides.
+
+- **Checkpoint/restore** — at round boundaries the server persists params,
+  round index, sample seed, the client-liveness table, the dropped log and
+  history through `utils/checkpoint.py` (same atomic meta.json contract as
+  the Simulator's; JSON-able server state rides meta["extra"]). A restarted
+  server (`resume=True`) loads the latest checkpoint, re-runs the status
+  handshake, and resumes at round N+1.
+- **Generation fencing** — every S2C/C2S training message carries a
+  run-generation (incarnation) header. A resumed server re-runs the round
+  that was in flight when it died, so a pre-restart straggler's round-echo
+  can EQUAL the live round index; the transport's `_rel_epoch` fences
+  delivery, not training semantics, so the FSM fences itself here.
+- **Client re-attach** — a CONNECTION_IS_READY after `is_initialized` is a
+  rejoin, not a no-op: the server re-runs the status handshake for that
+  client and re-sends the current round's payload if it is selected and
+  missing.
+- **Liveness eviction** — any C2S message (status / model / heartbeat)
+  refreshes a per-client last-seen stamp; a sweep flips `client_online`
+  False after `liveness_timeout_s` of silence and `_select_clients` stops
+  drafting evicted clients (previously each dead client cost a full
+  `round_timeout` every round it was selected). A recovered client re-enters
+  the pool on its next status/heartbeat.
 """
 from __future__ import annotations
 
 import logging
 import math
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -29,6 +54,7 @@ import numpy as np
 
 from ..comm import FedCommManager, Message
 from ..ops import tree as tu
+from ..utils import metrics as _mx
 from ..utils.events import recorder
 from . import message_define as md
 
@@ -75,10 +101,22 @@ class FedServerManager:
     partial aggregate. None (default) = reference behavior, wait forever.
     quorum_frac: fraction of selected clients that must have reported for a
     timed-out round to close (ceil; at least 1). Below quorum the timer
-    re-arms. Dropped clients stay in `client_ids` and rejoin later rounds.
+    re-arms, at most `max_rearms` times — then the run FAILS loudly
+    (`self.error` set, `fed.server.quorum_unreachable` counted, clients
+    released) instead of hanging forever. Dropped clients stay selectable
+    and rejoin later rounds.
     postprocess_agg_fn: (params, round_idx) -> params applied after
     aggregation — the on_after_aggregation hook site (reference:
     core/alg_frame/server_aggregator.py:79-83; central-DP noise lands here).
+
+    Durability knobs (ISSUE 10 — module docstring):
+    checkpoint_dir / checkpoint_every / checkpoint_keep — round-boundary
+    checkpoints through utils/checkpoint.py (every N completed rounds plus
+    the final one). resume=True loads the latest checkpoint at construction
+    and restarts at round N+1 with generation bumped.
+    liveness_timeout_s — evict clients silent for this long from selection
+    (arm it alongside client heartbeats shorter than the budget; see README
+    "Cross-silo durability" for tuning).
     """
 
     def __init__(self, comm: FedCommManager, client_ids: list[int],
@@ -89,7 +127,13 @@ class FedServerManager:
                  sample_seed: int = 0,
                  round_timeout: Optional[float] = None,
                  quorum_frac: float = 1.0,
-                 postprocess_agg_fn: Optional[Callable] = None):
+                 postprocess_agg_fn: Optional[Callable] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1,
+                 checkpoint_keep: Optional[int] = 3,
+                 resume: bool = False,
+                 liveness_timeout_s: Optional[float] = None,
+                 max_rearms: int = 5):
         self.comm = comm
         self.client_ids = list(client_ids)
         self.m = client_num_per_round or len(self.client_ids)
@@ -102,13 +146,30 @@ class FedServerManager:
         self.round_timeout = round_timeout
         self.quorum_frac = float(quorum_frac)
         self.postprocess_agg_fn = postprocess_agg_fn
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.checkpoint_keep = checkpoint_keep
+        self.liveness_timeout_s = liveness_timeout_s
+        self.max_rearms = int(max_rearms)
+        # tri-state liveness: absent = never heard from (selectable — round 0
+        # has no information yet), True = online, False = evicted. Only an
+        # explicit False is excluded from selection.
         self.client_online: dict[int, bool] = {}
+        self.last_seen: dict[int, float] = {}
+        self.generation = 0          # incarnation index; bumped per resume
         self.is_initialized = False
         self.done = threading.Event()
+        self.error: Optional[str] = None
         self.history: list[dict] = []
         self.dropped_log: list[tuple[int, list[int]]] = []  # (round, dropped ids)
+        self.round_clients: list[int] = []
+        self._synced: set[int] = set()   # sent the CURRENT round's payload
+        self._resumed = False
+        self._rearm_count = 0
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._liveness_timer: Optional[threading.Timer] = None
+        self._liveness_ref = time.monotonic()
 
         comm.register_message_receive_handler(
             md.CONNECTION_IS_READY, self._on_connection_ready)
@@ -116,29 +177,111 @@ class FedServerManager:
             md.C2S_CLIENT_STATUS, self._on_client_status)
         comm.register_message_receive_handler(
             md.C2S_SEND_MODEL, self._on_model_from_client)
+        comm.register_message_receive_handler(
+            md.C2S_HEARTBEAT, self._on_heartbeat)
         # clients ack S2C_FINISH with C2S_FINISHED; an unregistered type
         # raises in the receive loop, so the ack gets a no-op handler (the
         # ack races the stop sentinel, especially over gRPC)
         comm.register_message_receive_handler(
             md.C2S_FINISHED, lambda _msg: None)
 
+        if resume and checkpoint_dir is not None:
+            from ..utils.checkpoint import latest_round
+
+            if latest_round(checkpoint_dir) is not None:
+                self._restore(checkpoint_dir)
+            else:
+                log.info("resume requested but no checkpoints under %r — "
+                         "starting fresh", checkpoint_dir)
+
     # --- selection (reference: fedml_aggregator.client_selection — seeded by
     # round, matching fedavg_api.py:127-135)
     def _select_clients(self, round_idx: int) -> list[int]:
-        # sample from clients that have reported ONLINE (the init status check
-        # goes to every client, so later rounds can select any live one);
-        # before any status arrives — round 0 — fall back to the full list
-        pool = [c for c in self.client_ids if self.client_online.get(c, False)]
-        if len(pool) < self.m:
-            pool = list(self.client_ids)
+        # exclude only clients the liveness sweep has explicitly EVICTED
+        # (client_online[c] is False); never-seen clients stay selectable so
+        # round 0 — before any status arrives — draws from the full list.
+        # When eviction shrinks the pool below m, run the round over the
+        # survivors rather than padding with known-dead clients (each dead
+        # draftee used to cost a full round_timeout every round).
+        pool = [c for c in self.client_ids
+                if self.client_online.get(c, True) is not False]
+        if not pool:
+            pool = list(self.client_ids)   # everyone evicted: last resort
         if self.m >= len(pool):
             return sorted(pool)
         rng = np.random.RandomState(self.sample_seed + round_idx)
         return sorted(rng.choice(pool, self.m, replace=False).tolist())
 
+    # ------------------------------------------------------------- liveness
+    def _mark_alive(self, cid: int) -> None:
+        """Caller holds the lock. Any C2S traffic refreshes liveness; a
+        previously-evicted client re-enters the pool here."""
+        if cid not in self.client_ids:
+            return
+        self.last_seen[cid] = time.monotonic()
+        was = self.client_online.get(cid)
+        self.client_online[cid] = True
+        if was is False:
+            _mx.inc("fed.server.rejoins")
+            log.info("client %d recovered — back in the selection pool", cid)
+        self._publish_liveness()
+        if self.is_initialized:
+            self._maybe_send_round(cid)
+
+    def _publish_liveness(self) -> None:
+        _mx.set_gauge("fed.server.clients_online",
+                      sum(1 for v in self.client_online.values() if v))
+        _mx.set_gauge("fed.server.clients_total", len(self.client_ids))
+
+    def _arm_liveness(self) -> None:
+        if self.liveness_timeout_s is None or self.done.is_set():
+            return
+        t = threading.Timer(max(self.liveness_timeout_s / 2.0, 0.05),
+                            self._liveness_sweep)
+        t.daemon = True
+        t.start()
+        self._liveness_timer = t
+
+    def _liveness_sweep(self) -> None:
+        try:
+            with self._lock:
+                if self.done.is_set():
+                    return
+                now = time.monotonic()
+                for cid in self.client_ids:
+                    ref = self.last_seen.get(cid, self._liveness_ref)
+                    if self.client_online.get(cid) is not False \
+                            and now - ref > self.liveness_timeout_s:
+                        self.client_online[cid] = False
+                        _mx.inc("fed.server.evicted")
+                        log.warning(
+                            "client %d silent for %.1fs (> "
+                            "liveness_timeout_s=%.1fs) — evicted from "
+                            "selection", cid, now - ref,
+                            self.liveness_timeout_s)
+                self._publish_liveness()
+                if not self.is_initialized:
+                    # the init handshake may be blocked on an evicted
+                    # draftee: re-select round 0 over survivors, re-check
+                    self.round_clients = self._select_clients(0)
+                    self._maybe_init()
+        except Exception:  # noqa: BLE001 — one bad sweep must not end
+            log.exception("liveness sweep failed (chain continues)")
+        # re-arm OUTSIDE the guarded body: an exception above must not
+        # silently kill the whole liveness chain (_arm_liveness itself
+        # no-ops once done is set)
+        self._arm_liveness()
+
     # ------------------------------------------------------------- handlers
     def _on_connection_ready(self, msg: Message) -> None:
         if self.is_initialized:
+            # re-attach (restarted client, or any client after a server
+            # resume): re-run the status handshake for the SENDER; its
+            # status reply re-registers it online and pulls the current
+            # round's payload if it is selected and missing
+            _mx.inc("fed.server.reattach_announces")
+            self.comm.send_message(
+                Message(md.S2C_CHECK_CLIENT_STATUS, 0, msg.sender_id))
             return
         self.round_clients = self._select_clients(0)
         # status-check EVERY client, not just round 0's selection — clients
@@ -153,21 +296,57 @@ class FedServerManager:
         if status == md.STATUS_FINISHED:
             return
         with self._lock:
-            self.client_online[msg.sender_id] = True
-            all_online = all(self.client_online.get(c, False)
-                             for c in self.round_clients)
-            if all_online and not self.is_initialized:
-                self.is_initialized = True
-                self._send_init()
+            self._mark_alive(msg.sender_id)
+            self._maybe_init()
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        with self._lock:
+            self._mark_alive(msg.sender_id)
+
+    def _maybe_init(self) -> None:
+        """Caller holds the lock."""
+        if self.is_initialized or not self.round_clients:
+            return
+        if all(self.client_online.get(c, False) for c in self.round_clients):
+            self.is_initialized = True
+            self._send_init()
+
+    def _stamp(self, m: Message) -> Message:
+        m.add(md.KEY_MODEL_PARAMS, self.params)
+        m.add(md.KEY_ROUND, self.round_idx)
+        m.add(md.KEY_GENERATION, self.generation)
+        return m
 
     def _send_init(self) -> None:
         self.aggregator.reset(self.round_clients)
-        for cid in self.round_clients:
-            m = Message(md.S2C_INIT_CONFIG, 0, cid)
-            m.add(md.KEY_MODEL_PARAMS, self.params)
-            m.add(md.KEY_ROUND, self.round_idx)
-            self.comm.send_message(m)
+        self._broadcast_round()
         self._arm_timer()
+
+    def _broadcast_round(self) -> None:
+        """Caller holds the lock (or is pre-run single-threaded). Sends the
+        current round's payload to every selected client and records them
+        as synced (rejoin re-sends go through _maybe_send_round)."""
+        self._synced = set()
+        mtype = md.S2C_INIT_CONFIG if self.round_idx == 0 \
+            else md.S2C_SYNC_MODEL
+        for cid in self.round_clients:
+            self.comm.send_message(self._stamp(Message(mtype, 0, cid)))
+            self._synced.add(cid)
+
+    def _maybe_send_round(self, cid: int) -> None:
+        """Caller holds the lock. Re-send the in-flight round's payload to a
+        (re)joined client that is selected, missing, and not yet served —
+        the rejoin half of crash recovery: a restarted client (or every
+        client, after a server restart) pulls its work back instead of
+        waiting out the round."""
+        if self.done.is_set() or cid not in self.aggregator.expected \
+                or cid in self.aggregator.results or cid in self._synced:
+            return
+        mtype = md.S2C_INIT_CONFIG if self.round_idx == 0 \
+            else md.S2C_SYNC_MODEL
+        self.comm.send_message(self._stamp(Message(mtype, 0, cid)))
+        self._synced.add(cid)
+        _mx.inc("fed.server.rejoin_syncs")
 
     # ------------------------------------------------------ dropout handling
     def _arm_timer(self) -> None:
@@ -207,12 +386,46 @@ class FedServerManager:
                     self.dropped_log.append((self.round_idx, dropped))
                 self._complete_round()
             else:
-                # below quorum: keep waiting (re-arm), matching the spirit of
-                # the reference's wait-for-all rather than failing the run
+                # below quorum: re-arm, but BOUNDED (the reference waits
+                # forever; an unreachable quorum must fail the run loudly,
+                # not hang it silently — same contract as secagg_manager)
+                self._rearm_count += 1
+                if self._rearm_count > self.max_rearms:
+                    _mx.inc("fed.server.quorum_unreachable")
+                    self._fail(
+                        f"round {self.round_idx}: {received} received < "
+                        f"quorum {self._quorum()} after {self.max_rearms} "
+                        f"timeouts of {self.round_timeout}s — quorum "
+                        "unreachable")
+                    return
+                log.warning("round %d: %d received < quorum %d — re-arming "
+                            "(%d/%d)", self.round_idx, received,
+                            self._quorum(), self._rearm_count,
+                            self.max_rearms)
                 self._arm_timer()
+
+    def _fail(self, reason: str) -> None:
+        """Caller holds the lock. Record the error and release everyone —
+        clients get a FINISH so they exit instead of waiting on a server
+        that has declared the run dead."""
+        log.error("cross-silo run failed: %s", reason)
+        self.error = reason
+        self._finish()
 
     def _on_model_from_client(self, msg: Message) -> None:
         with self._lock:
+            # generation fence FIRST: a straggler from a previous server
+            # incarnation may echo the CURRENT round index (a resumed server
+            # re-runs the round that was in flight when it died) — the round
+            # echo alone cannot tell it apart
+            gen = msg.get(md.KEY_GENERATION)
+            if int(gen or 0) != self.generation:
+                _mx.inc("fed.server.stale_gen_rejected")
+                log.warning(
+                    "dropping C2S_SEND_MODEL from %s: generation %s != "
+                    "current %d (pre-restart straggler)", msg.sender_id,
+                    gen, self.generation)
+                return
             # a straggler's model from a closed round must not leak into the
             # current one — clients echo the round index they trained on;
             # a missing echo is rejected rather than assumed current (a
@@ -222,6 +435,7 @@ class FedServerManager:
                 log.warning("dropping C2S_SEND_MODEL from %s without %s",
                             msg.sender_id, md.KEY_ROUND)
                 return
+            self._mark_alive(msg.sender_id)
             if int(msg_round) != self.round_idx or \
                     msg.sender_id not in self.aggregator.expected:
                 return
@@ -236,6 +450,7 @@ class FedServerManager:
     def _complete_round(self) -> None:
         """Aggregate what's in the pool and advance. Caller holds the lock."""
         self._cancel_timer()
+        self._rearm_count = 0
         self.params = self.aggregator.aggregate()
         if self.postprocess_agg_fn is not None:
             self.params = self.postprocess_agg_fn(self.params, self.round_idx)
@@ -252,23 +467,127 @@ class FedServerManager:
             row.update(self.eval_fn(self.params, self.round_idx))
         self.history.append(row)
         recorder.log(row)
+        _mx.set_gauge("fed.round", self.round_idx)
+        if self._ckpt_due(self.round_idx):
+            self._save_checkpoint(self.round_idx)
         self.round_idx += 1
         if self.round_idx >= self.num_rounds:
             self._finish()
             return
         self.round_clients = self._select_clients(self.round_idx)
         self.aggregator.reset(self.round_clients)
-        for cid in self.round_clients:
-            m = Message(md.S2C_SYNC_MODEL, 0, cid)
-            m.add(md.KEY_MODEL_PARAMS, self.params)
-            m.add(md.KEY_ROUND, self.round_idx)
-            self.comm.send_message(m)
+        self._broadcast_round()
         self._arm_timer()
 
+    # ---------------------------------------------------- checkpoint/restore
+    def _ckpt_due(self, r: int) -> bool:
+        return self.checkpoint_dir is not None and self.checkpoint_every and (
+            (r + 1) % self.checkpoint_every == 0 or r == self.num_rounds - 1)
+
+    def _save_checkpoint(self, r: int) -> None:
+        """Caller holds the lock. Round-boundary write: params + the
+        JSON-able FSM state (meta["extra"]). Degrade, don't die — a full
+        disk must not kill a healthy federation."""
+        from ..utils import checkpoint as ckpt
+
+        extra = {
+            "kind": "cross_silo_server",
+            "generation": self.generation,
+            "sample_seed": self.sample_seed,
+            "num_rounds": self.num_rounds,
+            "client_ids": list(self.client_ids),
+            "client_online": {str(c): bool(v)
+                              for c, v in self.client_online.items()},
+            "dropped_log": [[rr, list(ids)] for rr, ids in self.dropped_log],
+        }
+        try:
+            with recorder.span("silo.checkpoint", round=r):
+                ckpt.save_checkpoint(
+                    self.checkpoint_dir, r, {"params": self.params},
+                    history=self.history, keep=self.checkpoint_keep,
+                    extra=extra)
+            _mx.inc("fed.server.checkpoints")
+        except Exception as e:  # noqa: BLE001 — durability must not kill runs
+            _mx.inc("fed.server.checkpoint_errors")
+            log.warning("round-%d checkpoint to %r failed (continuing): "
+                        "%s: %s", r, self.checkpoint_dir,
+                        type(e).__name__, e)
+
+    def _restore(self, path: str) -> None:
+        """Load the latest checkpoint and resume at round N+1 with the
+        generation bumped. Liveness is NOT trusted across a restart — the
+        table keeps only its keys' identities via the re-run status
+        handshake (every client re-registers before it gets work)."""
+        from ..utils import checkpoint as ckpt
+
+        # pin ONE round for both the meta read and the tensor restore: a
+        # dying incarnation's in-flight checkpoint write landing between
+        # the two would otherwise pair round N's liveness/generation state
+        # with round N+1's params
+        r = ckpt.latest_round(path)
+        meta = ckpt.read_meta(path, r)
+        extra = meta.get("extra") or {}
+        try:
+            _r, server, _c, _h, hist = ckpt.restore_checkpoint(
+                path, {"params": self.params}, round_idx=r)
+            params = server["params"]
+        except ckpt.CheckpointStructureError:
+            # cross-runtime compatibility: a Simulator-written checkpoint
+            # stores the full ServerState (params/opt_state/round/extra);
+            # the server path needs only its params subtree
+            raw = ckpt.restore_raw(path, round_idx=r)
+            if not (isinstance(raw, dict) and "params" in raw):
+                raise ckpt.CheckpointStructureError(
+                    f"checkpoint under {path!r} has no 'params' subtree "
+                    f"(top-level keys: {sorted(raw) if isinstance(raw, dict) else type(raw).__name__}) "
+                    "— not restorable into the cross-silo server")
+            try:
+                params = jax.tree.map(lambda _t, rr: rr, self.params,
+                                      raw["params"])
+            except (ValueError, TypeError) as e:
+                raise ckpt.CheckpointStructureError(
+                    f"checkpoint 'params' under {path!r} does not match "
+                    f"this server's model: {type(e).__name__}: "
+                    f"{str(e)[:200]}") from e
+            hist = meta.get("history", [])
+        self.params = jax.tree.map(np.asarray, params)
+        self.history = list(hist)
+        self.round_idx = int(meta["round"]) + 1
+        self.generation = int(extra.get("generation", 0)) + 1
+        if "sample_seed" in extra:
+            self.sample_seed = int(extra["sample_seed"])
+        self.dropped_log = [(int(rr), list(ids))
+                            for rr, ids in extra.get("dropped_log", [])]
+        # keys only: every client must re-register through the handshake
+        self.client_online = {}
+        self.last_seen = {}
+        self.is_initialized = True
+        self._resumed = True
+        if self.round_idx < self.num_rounds:
+            self.round_clients = self._select_clients(self.round_idx)
+        else:
+            self.round_clients = []
+        self.aggregator.reset(self.round_clients)
+        self._synced = set()
+        _mx.inc("fed.server.resumes")
+        _mx.set_gauge("fed.server.generation", self.generation)
+        _mx.set_gauge("fed.round", self.round_idx)
+        log.info("resumed from %r: %d rounds done, continuing at round %d "
+                 "as generation %d", path, len(self.history), self.round_idx,
+                 self.generation)
+
+    # ------------------------------------------------------------- shutdown
     def _finish(self) -> None:
         self._cancel_timer()
+        if self._liveness_timer is not None:
+            self._liveness_timer.cancel()
         for cid in self.client_ids:
-            self.comm.send_message(Message(md.S2C_FINISH, 0, cid))
+            try:
+                self.comm.send_message(
+                    Message(md.S2C_FINISH, 0, cid)
+                    .add(md.KEY_GENERATION, self.generation))
+            except Exception:  # noqa: BLE001 — dead clients may be
+                log.debug("S2C_FINISH to %s failed", cid, exc_info=True)
         self.done.set()
         # callers hold self._lock; comm.stop() joins the receive thread, which
         # may itself be blocked on the lock in a handler — stop from a fresh
@@ -276,4 +595,26 @@ class FedServerManager:
         threading.Thread(target=self.comm.stop, daemon=True).start()
 
     def run(self, background: bool = False) -> None:
+        self._liveness_ref = time.monotonic()
+        self._arm_liveness()
+        if self._resumed and not self.done.is_set():
+            if self.round_idx >= self.num_rounds:
+                # checkpoint already covers the whole run: release clients
+                with self._lock:
+                    self._finish()
+            else:
+                # the resumed server INITIATES the re-handshake: clients
+                # that survived the crash are idle in their receive loops
+                # and (absent an optional watchdog) would never announce
+                # on their own — recovery must not depend on client-side
+                # knobs being set
+                for cid in self.client_ids:
+                    self.comm.send_message(
+                        Message(md.S2C_CHECK_CLIENT_STATUS, 0, cid))
+                if self.round_timeout is not None:
+                    # guard the reconnect window the same way a live round
+                    # is guarded: quorum math + bounded re-arms
+                    self._arm_timer()
         self.comm.run(background=background)
+        if not background and self.error:
+            raise RuntimeError(self.error)

@@ -1,0 +1,314 @@
+"""Kill–restart chaos soak harness for the cross-silo federation (ISSUE 10).
+
+The chaos plane (comm/chaos.py) injects LINK faults under a live process;
+this harness injects PROCESS DEATH: it runs a whole federation in-process
+over loopback threads and severs a role the way SIGKILL would — receive
+loop cut at the transport, timers cancelled, no farewell, no final
+checkpoint flush — then restarts it as a fresh manager object on the same
+rank. The loopback mailboxes keep whatever frames were in flight, exactly
+like a real dead process's unread sockets, so stale pre-restart traffic
+(the generation-fencing target) occurs naturally.
+
+Kill schedules can ride the chaos plane's declarative spec
+(`FaultSpec.silo_kill = {rank: round}` — rank 0 is the server): the soak
+driver consults it the way the transports consult crash/flap.
+
+Shared by tests/test_silo_durability.py, the `cross_silo_durability_smoke`
+diagnosis probe, and bench.py's `cross_silo_durability_*` rows. The
+subprocess SIGKILL recipe for real deployments is documented in README
+"Cross-silo durability".
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..comm import FedCommManager
+from ..comm.loopback import LoopbackTransport, release_router
+from ..config import TrainArgs
+from ..models import hub
+from .client import FedClientManager
+from .server import FedServerManager
+from .trainer import SiloTrainer
+
+
+def _client_data(seed: int, n: int = 64, d: int = 8, classes: int = 3):
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(d, classes)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+    return x, y
+
+
+class SiloSoakHarness:
+    """One in-process federation: a server and `n_clients` clients on a
+    private loopback namespace, each startable, killable, and restartable
+    independently. Deterministic end to end (seeded data, round-seeded
+    trainers, sorted-id aggregation), so final params from any two runs
+    with the same participation are bitwise-comparable."""
+
+    def __init__(self, n_clients: int = 2, rounds: int = 4,
+                 checkpoint_dir: Optional[str] = None, seed: int = 0,
+                 run_id: Optional[str] = None,
+                 server_kw: Optional[dict] = None,
+                 client_kw: Optional[dict] = None):
+        self.n_clients = n_clients
+        self.rounds = rounds
+        self.checkpoint_dir = checkpoint_dir
+        self.run_id = run_id or f"soak-{uuid.uuid4().hex[:8]}"
+        self.server_kw = dict(server_kw or {})
+        self.client_kw = dict(client_kw or {})
+        self.model = hub.create("lr", 3)
+        self.targs = TrainArgs(
+            epochs=2, batch_size=16, learning_rate=0.3,
+            client_num_in_total=n_clients, client_num_per_round=n_clients,
+            comm_round=rounds)
+        self.init_params = jax.tree.map(
+            np.asarray, hub.init_params(self.model, (8,),
+                                        jax.random.key(seed)))
+        self.server: Optional[FedServerManager] = None
+        self.clients: dict[int, FedClientManager] = {}
+        self._dead = []          # killed managers, kept so threads can drain
+
+    # ------------------------------------------------------------- plumbing
+    def _comm(self, rank: int) -> FedCommManager:
+        return FedCommManager(LoopbackTransport(rank, self.run_id), rank)
+
+    def _trainer(self, cid: int) -> SiloTrainer:
+        x, y = _client_data(cid)
+        return SiloTrainer(self.model.apply, self.targs, x, y, seed=cid)
+
+    # --------------------------------------------------------------- roles
+    def start_server(self, resume: bool = False, **over) -> FedServerManager:
+        kw = dict(self.server_kw)
+        kw.update(over)
+        if self.checkpoint_dir is not None:
+            kw.setdefault("checkpoint_dir", self.checkpoint_dir)
+            kw.setdefault("checkpoint_every", 1)
+        self.server = FedServerManager(
+            self._comm(0), client_ids=list(range(1, self.n_clients + 1)),
+            init_params=self.init_params, num_rounds=self.rounds,
+            resume=resume, **kw)
+        self.server.run(background=True)
+        return self.server
+
+    def start_client(self, cid: int, **over) -> FedClientManager:
+        kw = dict(self.client_kw)
+        kw.update(over)
+        c = FedClientManager(self._comm(cid), cid, self._trainer(cid), **kw)
+        self.clients[cid] = c
+        c.run(background=True)
+        c.announce_ready()
+        return c
+
+    def start_all(self) -> "SiloSoakHarness":
+        self.start_server()
+        for cid in range(1, self.n_clients + 1):
+            self.start_client(cid)
+        return self
+
+    # ---------------------------------------------------------------- kills
+    def kill_server(self) -> None:
+        """The in-process SIGKILL analog: sever the receive loop, wait for
+        the pump thread to wind down, then cancel the timers. No FINISH,
+        no checkpoint flush. The ordering matters: an in-flight handler
+        may still complete its current transition (a real SIGKILL lands
+        mid-instruction; thread semantics cannot) and that transition
+        re-arms the round timer — cancelling BEFORE the join would leave a
+        zombie timer driving the dead incarnation's FSM alongside the
+        restarted one. The soak's invariants hold either way because
+        resume is deterministic from whatever checkpoint last hit disk."""
+        srv = self.server
+        assert srv is not None
+        srv.comm.transport.stop_receive_message()
+        th = srv.comm._thread
+        if th is not None:
+            th.join(timeout=10)
+        with srv._lock:
+            srv._cancel_timer()
+            if srv._liveness_timer is not None:
+                srv._liveness_timer.cancel()
+        self._dead.append(srv)
+        self.server = None
+
+    def kill_client(self, cid: int) -> None:
+        c = self.clients.pop(cid)
+        c._stopped.set()                 # halt heartbeat/watchdog loops
+        c.comm.transport.stop_receive_message()
+        th = c.comm._thread
+        if th is not None:
+            th.join(timeout=10)
+        self._dead.append(c)
+
+    # ------------------------------------------------------------- helpers
+    def wait_history(self, n: int, timeout: float = 60.0) -> bool:
+        """Block until the live server has completed >= n rounds."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            srv = self.server
+            if srv is not None and len(srv.history) >= n:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def wait_done(self, timeout: float = 120.0) -> bool:
+        srv = self.server
+        assert srv is not None
+        ok = srv.done.wait(timeout)
+        for c in self.clients.values():
+            c.done.wait(5)
+        return ok
+
+    def close(self) -> None:
+        for obj in ([self.server] if self.server else []) \
+                + list(self.clients.values()):
+            try:
+                if isinstance(obj, FedServerManager):
+                    obj._cancel_timer()
+                    if obj._liveness_timer is not None:
+                        obj._liveness_timer.cancel()
+                else:
+                    obj._stopped.set()
+                obj.comm.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        release_router(self.run_id)
+
+
+def uninterrupted_final_params(n_clients: int = 2, rounds: int = 4,
+                               seed: int = 0):
+    """Reference run: same federation, no faults. Returns (params, history).
+    The soak's bitwise bar compares against this."""
+    h = SiloSoakHarness(n_clients=n_clients, rounds=rounds, seed=seed)
+    try:
+        h.start_all()
+        if not h.wait_done(timeout=120):
+            raise TimeoutError("uninterrupted reference run did not finish")
+        return h.server.params, list(h.server.history)
+    finally:
+        h.close()
+
+
+def chaos_kill_soak(spec, checkpoint_dir: str, n_clients: int = 2,
+                    rounds: int = 5, seed: int = 0,
+                    server_timeout_s: float = 0.5,
+                    timeout: float = 180.0) -> dict:
+    """Drive a federation under a `FaultSpec.silo_kill` schedule
+    ({rank: round} — rank 0 is the server): each scheduled rank is severed
+    once the run has completed that many rounds, then restarted (the server
+    with `resume=True`, clients as fresh manager objects on their rank).
+    Kills land at round boundaries, where every scheduled client is idle
+    between its upload and the next sync — so a full-participation run
+    stays full-participation and the final params are bitwise-comparable
+    to an uninterrupted run's.
+    """
+    kills = dict(spec.silo_kill) if hasattr(spec, "silo_kill") \
+        else dict(spec or {})
+    h = SiloSoakHarness(
+        n_clients=n_clients, rounds=rounds, checkpoint_dir=checkpoint_dir,
+        seed=seed,
+        server_kw=dict(round_timeout=10.0, quorum_frac=1.0),
+        # generous re-attach budget: on a loaded box the restarted
+        # server's checkpoint restore can take seconds, and a client that
+        # exhausts its budget into that window is dead for good
+        client_kw=dict(server_timeout_s=server_timeout_s, reattach=True,
+                       max_reattach=120))
+    try:
+        h.start_all()
+        pending = sorted(kills.items(), key=lambda kv: (kv[1], kv[0]))
+        executed = []
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            srv = h.server
+            done_rounds = len(srv.history) if srv is not None else 0
+            fired = False
+            for rank, after in list(pending):
+                if srv is None or done_rounds < after:
+                    continue
+                pending.remove((rank, after))
+                executed.append((rank, after))
+                if rank == 0:
+                    h.kill_server()
+                    h.start_server(resume=True)
+                else:
+                    h.kill_client(rank)
+                    h.start_client(rank)
+                fired = True
+                break       # one kill per poll; re-read state
+            if not fired:
+                if not pending and h.server is not None \
+                        and h.server.done.wait(0.05):
+                    break
+                time.sleep(0.01)
+        srv = h.server
+        if srv is None or not srv.done.is_set():
+            raise TimeoutError(
+                f"chaos soak did not finish (kills executed: {executed}, "
+                f"pending: {pending})")
+        for c in h.clients.values():
+            c.done.wait(10)
+        from ..utils import metrics as _mx
+
+        snap = _mx.snapshot()["counters"]
+        return {
+            "params": srv.params,
+            "history": list(srv.history),
+            "error": srv.error,
+            "kills": executed,
+            "generation": srv.generation,
+            "resumes": int(snap.get("fed.server.resumes", 0)),
+            "stale_gen_rejected": int(
+                snap.get("fed.server.stale_gen_rejected", 0)),
+        }
+    finally:
+        h.close()
+
+
+def server_kill_restart_soak(checkpoint_dir: str, n_clients: int = 2,
+                             rounds: int = 4, kill_after: int = 2,
+                             seed: int = 0,
+                             server_timeout_s: float = 0.5) -> dict:
+    """The headline soak: SIGKILL the server once it has completed
+    `kill_after` rounds (the next round is already in flight — clients are
+    training against the dead incarnation), restart it with resume, and
+    run to completion. Clients re-attach through their server-silence
+    watchdog. Returns final params, history, the restart's recovery time,
+    and the relevant counters for assertions."""
+    from ..utils import metrics as _mx
+
+    h = SiloSoakHarness(
+        n_clients=n_clients, rounds=rounds, checkpoint_dir=checkpoint_dir,
+        seed=seed,
+        server_kw=dict(round_timeout=10.0, quorum_frac=1.0),
+        client_kw=dict(server_timeout_s=server_timeout_s, reattach=True,
+                       max_reattach=120))
+    try:
+        h.start_all()
+        if not h.wait_history(kill_after, timeout=60):
+            raise TimeoutError(
+                f"server never completed {kill_after} rounds pre-kill")
+        h.kill_server()
+        t0 = time.perf_counter()
+        srv = h.start_server(resume=True)
+        recovered = h.wait_done(timeout=120)
+        recovery_s = time.perf_counter() - t0
+        if not recovered:
+            raise TimeoutError("resumed run did not finish")
+        snap = _mx.snapshot()["counters"]
+        return {
+            "params": srv.params,
+            "history": list(srv.history),
+            "generation": srv.generation,
+            "error": srv.error,
+            "recovery_s": recovery_s,
+            "resumes": int(snap.get("fed.server.resumes", 0)),
+            "stale_gen_rejected": int(
+                snap.get("fed.server.stale_gen_rejected", 0)),
+            "reattaches": int(snap.get("fed.client.reattaches", 0)),
+        }
+    finally:
+        h.close()

@@ -64,6 +64,11 @@ class SiloTrainer:
         self.objective = make_objective(t.extra.get("task"))
         self.seed = seed
         self._jit_train = jax.jit(self._train_impl)
+        # rejoin memo (ISSUE 10): a re-attach or server resume re-sends the
+        # in-flight round, and the round's inputs are deterministic — same
+        # round index + same incoming params ⇒ same local result. Caching
+        # the last round turns the re-train into an equality check.
+        self._memo: Optional[tuple] = None   # (round_idx, params_np, result)
 
     def _train_impl(self, params, rng):
         shard = {"x": self.x, "y": self.y, "mask": self.mask}
@@ -77,7 +82,19 @@ class SiloTrainer:
 
     def train(self, params_np: Pytree, round_idx: int):
         """(params numpy pytree) -> (new params numpy pytree, n, metrics) —
-        the ClientTrainer.train contract (reference: client_trainer.py:52)."""
+        the ClientTrainer.train contract (reference: client_trainer.py:52).
+        A repeat of the memoized round with bit-identical incoming params
+        (a durability re-send) returns the cached result."""
+        if self._memo is not None and self._memo[0] == round_idx:
+            try:
+                same = all(jax.tree.leaves(jax.tree.map(
+                    lambda a, b: bool(np.array_equal(np.asarray(a),
+                                                     np.asarray(b))),
+                    self._memo[1], params_np)))
+            except (ValueError, TypeError):
+                same = False
+            if same:
+                return self._memo[2]
         params = jax.tree.map(jnp.asarray, params_np)
         rng = jax.random.fold_in(jax.random.key(self.seed), round_idx)
         new_params, m = self._jit_train(params, rng)
@@ -87,4 +104,6 @@ class SiloTrainer:
             "train_loss": float(m.loss_sum) / max(cnt, 1.0),
             "train_acc": float(m.correct) / max(cnt, 1.0),
         }
-        return out, self.n_samples, metrics
+        result = (out, self.n_samples, metrics)
+        self._memo = (round_idx, params_np, result)
+        return result

@@ -119,6 +119,20 @@ class FedMLRunner:
         comm = FedCommManager(tr, rank)
         secagg = bool(t.extra.get("secagg"))
         client_ids = list(range(1, t.client_num_in_total + 1))
+        # durability knobs (ISSUE 10): round-boundary checkpoint/resume on
+        # the server, silence watchdog + heartbeats on the client. Same
+        # checkpoint_dir/checkpoint_every keys the Simulator reads;
+        # validated at config load.
+        ck_every = t.extra.get("checkpoint_every")
+        ckpt_kw = dict(
+            checkpoint_dir=t.extra.get("checkpoint_dir"),
+            # an EXPLICIT 0 means "no cadence checkpoints" (config.py
+            # validates >= 0; _ckpt_due treats 0 as off) — `or 1` here
+            # would silently re-enable what the operator disabled
+            checkpoint_every=1 if ck_every is None else int(ck_every),
+            checkpoint_keep=int(t.extra.get("checkpoint_keep", 3)),
+            resume=bool(t.extra.get("resume")),
+        )
 
         if role == "server":
             if model is None or "input_shape" not in kw:
@@ -133,7 +147,8 @@ class FedMLRunner:
                 return SecAggServerManager(
                     comm, client_ids=client_ids, init_params=params,
                     num_rounds=t.comm_round,
-                    round_timeout=t.extra.get("round_timeout"), **kw)
+                    round_timeout=t.extra.get("round_timeout"),
+                    **ckpt_kw, **kw)
             from .cross_silo import FedServerManager
 
             return FedServerManager(
@@ -141,7 +156,10 @@ class FedMLRunner:
                 num_rounds=t.comm_round,
                 client_num_per_round=t.client_num_per_round,
                 round_timeout=t.extra.get("round_timeout"),
-                quorum_frac=float(t.extra.get("quorum_frac", 1.0)), **kw)
+                quorum_frac=float(t.extra.get("quorum_frac", 1.0)),
+                liveness_timeout_s=t.extra.get("liveness_timeout_s"),
+                max_rearms=int(t.extra.get("max_rearms", 5)),
+                **ckpt_kw, **kw)
 
         # role == client: rank is the client id (1-based)
         if dataset is None or model is None:
@@ -164,7 +182,13 @@ class FedMLRunner:
                 client_ids=client_ids, **kw)
         from .cross_silo import FedClientManager
 
-        return FedClientManager(comm, rank, trainer, **kw)
+        # a resumable server implies re-attaching clients (they must
+        # re-announce to the restarted incarnation); `reattach` overrides
+        return FedClientManager(
+            comm, rank, trainer,
+            server_timeout_s=t.extra.get("server_timeout_s"),
+            reattach=bool(t.extra.get("reattach", t.extra.get("resume"))),
+            heartbeat_s=t.extra.get("heartbeat_s"), **kw)
 
     # ---------------------------------------------------------- cross-device
     def _init_cross_device(self, dataset, model, role, rank, transport, **kw):

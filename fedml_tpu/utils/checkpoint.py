@@ -41,6 +41,14 @@ def _round_dir(path: str, round_idx: int) -> str:
     return os.path.join(os.path.abspath(path), f"round_{round_idx}")
 
 
+class CheckpointStructureError(ValueError):
+    """A checkpoint exists but its tree structure does not match the
+    caller's template — e.g. a cross-silo-server checkpoint (params only)
+    restored into a Simulator (full ServerState), or vice versa. Raised
+    instead of letting an orbax traceback escape, so the operator sees
+    *what* is incompatible rather than a tree-mapping stack."""
+
+
 def latest_round(path: str) -> Optional[int]:
     """Highest complete checkpoint round under `path`, or None."""
     if not os.path.isdir(path):
@@ -53,12 +61,28 @@ def latest_round(path: str) -> Optional[int]:
     return max(rounds) if rounds else None
 
 
+def read_meta(path: str, round_idx: Optional[int] = None) -> dict:
+    """The meta.json sidecar (round, wall time, history, writer `extra`)
+    without touching any tensors — the cheap-inspection half of the
+    checkpoint contract. The cross-silo server keeps its JSON-able state
+    (liveness table, dropped log, generation, sample seed) in
+    meta["extra"]; a Simulator checkpoint simply has no such key."""
+    r = round_idx if round_idx is not None else latest_round(path)
+    if r is None:
+        raise FileNotFoundError(f"no checkpoints under {path!r}")
+    with open(os.path.join(_round_dir(path, r), "meta.json")) as f:
+        return json.load(f)
+
+
 def save_checkpoint(path: str, round_idx: int, server_state: Pytree,
                     client_states: Pytree = None, hook_state: Pytree = None,
                     history: Optional[list] = None,
-                    keep: Optional[int] = 3) -> str:
+                    keep: Optional[int] = 3,
+                    extra: Optional[dict] = None) -> str:
     """Write one checkpoint; returns its directory. `keep` prunes older
-    rounds (None keeps everything)."""
+    rounds (None keeps everything). `extra` is a JSON-able dict stored in
+    meta.json — writer-specific sidecar state (the cross-silo server's
+    liveness/generation bookkeeping) that must not require orbax to read."""
     d = _round_dir(path, round_idx)
     # a crash between the tree writes and meta.json leaves a half-written
     # directory; orbax refuses to overwrite, so clear the stale attempt
@@ -81,6 +105,8 @@ def save_checkpoint(path: str, round_idx: int, server_state: Pytree,
     # the checkpoint complete, so it must never exist half-written
     meta = {"round": round_idx, "time": time.time(), "present": present,
             "history": history or []}
+    if extra is not None:
+        meta["extra"] = extra
     tmp = os.path.join(d, "meta.json.tmp")
     with open(tmp, "w") as f:
         json.dump(meta, f)
@@ -110,8 +136,14 @@ def restore_checkpoint(path: str, server_template: Pytree,
     def load(name, template):
         if not meta["present"].get(name) or template is None:
             return None
-        restored = ckptr.restore(
-            os.path.join(d, name), {"tree": template})["tree"]
+        try:
+            restored = ckptr.restore(
+                os.path.join(d, name), {"tree": template})["tree"]
+        except FileNotFoundError:
+            raise
+        except Exception as e:  # noqa: BLE001 — re-raise with structure diff
+            raise CheckpointStructureError(
+                _structure_mismatch(d, name, template, e)) from e
 
         # Re-establish the template's placement. Orbax returns arrays
         # COMMITTED to a device; a fresh run's arrays are uncommitted (jit
@@ -130,6 +162,49 @@ def restore_checkpoint(path: str, server_template: Pytree,
     clients = load("client_states", client_template)
     hook = load("hook_state", hook_template)
     return r, server, clients, hook, meta.get("history", [])
+
+
+def _leaf_paths(tree: Pytree, limit: int = 12) -> list[str]:
+    paths = ["/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                      for k in p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+    return paths[:limit] + (["..."] if len(paths) > limit else [])
+
+
+def _structure_mismatch(d: str, name: str, template: Pytree,
+                        cause: Exception) -> str:
+    """Human-readable structure diff for a failed templated restore: what
+    the checkpoint actually holds vs what the caller expected. The two
+    writers sharing this module (Simulator, cross-silo server) store
+    differently-shaped server_state trees — restoring one into the other
+    must say so, not dump an orbax traceback."""
+    try:
+        saved = restore_raw(os.path.dirname(d), name,
+                            int(os.path.basename(d).split("_")[1]))
+        saved_desc = f"saved leaves {_leaf_paths(saved)}"
+    except Exception:  # noqa: BLE001 — the diff is best-effort
+        saved_desc = "saved tree unreadable"
+    return (f"checkpoint {name!r} under {d!r} does not match the restore "
+            f"template: {saved_desc} vs template leaves "
+            f"{_leaf_paths(template)} — was this checkpoint written by a "
+            f"different runtime (Simulator vs cross-silo server)? "
+            f"({type(cause).__name__}: {str(cause)[:200]})")
+
+
+def restore_raw(path: str, name: str = "server_state",
+                round_idx: Optional[int] = None) -> Pytree:
+    """Template-free restore of one checkpoint part, as nested dicts of
+    host arrays. The cross-runtime compatibility hook: the cross-silo
+    server uses this to lift the `params` subtree out of a
+    Simulator-written ServerState checkpoint (whose opt_state/round/extra
+    it has no template for)."""
+    r = round_idx if round_idx is not None else latest_round(path)
+    if r is None:
+        raise FileNotFoundError(f"no checkpoints under {path!r}")
+    d = os.path.join(_round_dir(path, r), name)
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"checkpoint part {name!r} absent at {d!r}")
+    return ocp.StandardCheckpointer().restore(d)["tree"]
 
 
 def _prune(path: str, keep: int) -> None:

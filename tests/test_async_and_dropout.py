@@ -71,10 +71,10 @@ class FlakyClientManager(FedClientManager):
         super().__init__(*args, **kw)
         self.drop_rounds = set(drop_rounds)
 
-    def _train_and_send(self, params, round_idx):
+    def _train_and_send(self, params, round_idx, gen=0):
         if round_idx in self.drop_rounds:
             return  # vanish for this round
-        super()._train_and_send(params, round_idx)
+        super()._train_and_send(params, round_idx, gen=gen)
 
 
 def _lin_trainer(model, t, seed):

@@ -67,6 +67,71 @@ def test_restore_raises_without_checkpoints(tmp_path):
         restore_checkpoint(str(tmp_path / "nope"), {})
 
 
+# --------------------------------------------- cross-runtime layout compat
+# (ISSUE 10: the Simulator and the cross-silo server share
+# utils/checkpoint.py — a Simulator checkpoint must restore into the
+# server path, and the reverse mismatch must error LOUDLY, not with an
+# orbax traceback)
+def test_simulator_checkpoint_restores_into_server_path(tmp_path):
+    from fedml_tpu.comm import FedCommManager
+    from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+    from fedml_tpu.cross_silo import FedServerManager
+
+    ckpt = str(tmp_path / "ckpt")
+    sim = Simulator(_cfg(comm_round=3, federated_optimizer="FedAvg"))
+    sim.run(checkpoint_dir=ckpt, checkpoint_every=1)
+    template = jax.tree.map(np.asarray, sim.server_state.params)
+    srv = FedServerManager(
+        FedCommManager(LoopbackTransport(0, "ck-compat"), 0),
+        client_ids=[1, 2], init_params=jax.tree.map(np.zeros_like, template),
+        num_rounds=6, checkpoint_dir=ckpt, resume=True)
+    assert srv.round_idx == 3 and srv.generation == 1
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), srv.params, template)
+    release_router("ck-compat")
+
+
+def test_server_checkpoint_into_simulator_errors_loudly(tmp_path):
+    from fedml_tpu.utils.checkpoint import (
+        CheckpointStructureError, save_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    sim = Simulator(_cfg(comm_round=2, federated_optimizer="FedAvg"))
+    # a cross-silo-server-shaped checkpoint: params only, no opt_state/round
+    save_checkpoint(ckpt, 0,
+                    {"params": jax.tree.map(np.asarray,
+                                            sim.server_state.params)},
+                    extra={"kind": "cross_silo_server", "generation": 0})
+    with pytest.raises(CheckpointStructureError) as ei:
+        sim.restore(ckpt)
+    msg = str(ei.value)
+    assert "does not match the restore template" in msg
+    assert "different runtime" in msg
+    assert "Traceback" not in msg
+
+
+def test_meta_extra_roundtrip_and_raw_restore(tmp_path):
+    from fedml_tpu.utils.checkpoint import (
+        read_meta, restore_raw, save_checkpoint,
+    )
+
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, 4, {"params": {"w": np.arange(6.0, dtype=np.float32)}},
+                    history=[{"round": 4}],
+                    extra={"kind": "cross_silo_server", "generation": 2,
+                           "client_online": {"1": True, "2": False}})
+    meta = read_meta(d)
+    assert meta["round"] == 4
+    assert meta["extra"]["generation"] == 2
+    assert meta["extra"]["client_online"] == {"1": True, "2": False}
+    raw = restore_raw(d)
+    np.testing.assert_array_equal(raw["params"]["w"],
+                                  np.arange(6.0, dtype=np.float32))
+    with pytest.raises(FileNotFoundError):
+        restore_raw(d, "client_states")
+
+
 def test_checkpoint_pruning(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     sim = Simulator(_cfg(comm_round=5, federated_optimizer="FedAvg"))

@@ -1,0 +1,536 @@
+"""Cross-silo crash durability (ISSUE 10): server checkpoint/restore with
+generation fencing, client rejoin, liveness-aware selection, bounded quorum
+re-arms, the secagg × resume contract, and the kill–restart chaos soak.
+
+The kill–restart soaks run in-process over loopback (cross_silo/soak.py —
+the SIGKILL analog severs the receive loop with no farewell and leaves
+stale frames in the mailboxes, like a dead process's unread sockets). The
+bitwise bar: a killed-and-resumed run must end with final params
+bit-identical to an uninterrupted run's."""
+import functools
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import FedCommManager, Message
+from fedml_tpu.comm.chaos import FaultSpec
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.config import Config, TrainArgs
+from fedml_tpu.cross_silo import (
+    FedClientManager, FedServerManager, SecAggClientManager,
+    SecAggServerManager, SiloTrainer, message_define as md,
+)
+from fedml_tpu.cross_silo.soak import (
+    SiloSoakHarness, chaos_kill_soak, uninterrupted_final_params,
+)
+from fedml_tpu.models import hub
+from fedml_tpu.utils import metrics as mx
+
+
+def _bitwise_equal(a, b) -> bool:
+    return all(jax.tree.leaves(jax.tree.map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))),
+        a, b)))
+
+
+@functools.lru_cache(maxsize=4)
+def _reference(n_clients: int, rounds: int):
+    params, hist = uninterrupted_final_params(n_clients=n_clients,
+                                              rounds=rounds)
+    return params, tuple(r["round"] for r in hist)
+
+
+# ------------------------------------------------------------ kill–restart
+def test_chaos_soak_server_and_each_client_killed_once(tmp_path):
+    """THE acceptance soak, driven by the chaos plane's silo_kill schedule:
+    the server is SIGKILLed mid-run (round 3 is in flight when it dies
+    after 2 completed rounds) and EACH client dies once; everyone
+    restarts (the server with resume — it re-handshakes as generation 1;
+    the client watchdog is the slow-restart backstop, so
+    fed.client.reattaches may legitimately stay 0 on a fast restart); the
+    run completes with full participation and final params bitwise-equal
+    to an uninterrupted run's. (`server_kill_restart_soak`, the
+    server-only variant, stays covered by the required
+    cross_silo_durability_smoke diagnosis probe and the bench rows.)"""
+    ref, ref_rounds = _reference(2, 4)
+    spec = FaultSpec(silo_kill={0: 2, 1: 1, 2: 3})
+    out = chaos_kill_soak(spec, str(tmp_path / "ckpt"), n_clients=2,
+                          rounds=4)
+    assert out["error"] is None
+    assert sorted(r for r, _ in out["kills"]) == [0, 1, 2]
+    assert tuple(h["round"] for h in out["history"]) == ref_rounds
+    assert all(h["n_received"] == 2 for h in out["history"]), \
+        f"participation dropped: {out['history']}"
+    assert out["generation"] == 1 and out["resumes"] >= 1
+    assert _bitwise_equal(ref, out["params"]), \
+        "resumed final params differ from the uninterrupted run"
+
+
+def test_generation_fencing_rejects_crafted_stale_frame(tmp_path):
+    """A crafted C2S_SEND_MODEL carrying the CURRENT round index but a
+    PREVIOUS incarnation's generation must be rejected (the round echo
+    alone cannot fence a straggler whose round the resumed server is
+    re-running)."""
+    h = SiloSoakHarness(n_clients=2, rounds=3,
+                        checkpoint_dir=str(tmp_path / "ckpt"),
+                        server_kw=dict(round_timeout=10.0))
+    try:
+        h.start_all()
+        assert h.wait_history(1, timeout=60)
+        h.kill_server()
+        srv = h.start_server(resume=True)
+        assert srv.generation == 1
+        before = mx.snapshot()["counters"].get(
+            "fed.server.stale_gen_rejected", 0)
+        stale = Message(md.C2S_SEND_MODEL, 1, 0)
+        stale.add(md.KEY_MODEL_PARAMS, h.init_params)
+        stale.add(md.KEY_NUM_SAMPLES, 64)
+        stale.add(md.KEY_ROUND, srv.round_idx)     # the LIVE round index
+        stale.add(md.KEY_GENERATION, 0)            # …from the dead gen
+        srv._on_model_from_client(stale)
+        assert 1 not in srv.aggregator.results, \
+            "stale-generation model entered the aggregation pool"
+        after = mx.snapshot()["counters"]["fed.server.stale_gen_rejected"]
+        assert after >= before + 1
+        # same frame with the live generation IS accepted (fence, not wall)
+        fresh = Message(md.C2S_SEND_MODEL, 1, 0)
+        fresh.add(md.KEY_MODEL_PARAMS, h.init_params)
+        fresh.add(md.KEY_NUM_SAMPLES, 64)
+        fresh.add(md.KEY_ROUND, srv.round_idx)
+        fresh.add(md.KEY_GENERATION, srv.generation)
+        srv._on_model_from_client(fresh)
+        assert 1 in srv.aggregator.results
+    finally:
+        h.close()
+
+
+# ------------------------------------------------- liveness + rejoin paths
+def test_dead_client_evicted_then_recovered_rejoins(tmp_path):
+    """A silent client is evicted from selection after its miss budget (no
+    more round_timeout stalls on its account); once it comes back, its
+    first status re-enters it into the pool."""
+    # round_timeout must cover the rejoined client's cold jit compile
+    # (~1s) or its first post-recovery round is timeout-dropped and the
+    # re-selection assertion races the end of the run
+    h = SiloSoakHarness(
+        n_clients=3, rounds=5,
+        server_kw=dict(round_timeout=1.5, quorum_frac=0.5,
+                       liveness_timeout_s=0.9))
+    try:
+        h.start_server()
+        for cid in (1, 2):       # client 3 absent from the start
+            h.start_client(cid, heartbeat_s=0.2)
+        # pre-init eviction: the round-0 handshake would block on client 3
+        # forever; the liveness sweep must evict it and re-select
+        assert h.wait_history(2, timeout=60)
+        snap = mx.snapshot()["counters"]
+        assert snap.get("fed.server.evicted", 0) >= 1
+        assert h.server.client_online.get(3) is False
+        assert 3 not in h.server.round_clients, \
+            "evicted client still being drafted"
+        rejoins_before = snap.get("fed.server.rejoins", 0)
+        # recovery: client 3 appears, announces, and must be re-selected
+        h.start_client(3, heartbeat_s=0.2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                h.server.client_online.get(3) is not True:
+            time.sleep(0.02)
+        assert h.server.client_online.get(3) is True, "client 3 never rejoined"
+        assert mx.snapshot()["counters"]["fed.server.rejoins"] \
+            >= rejoins_before + 1
+        assert h.wait_done(timeout=60)
+        # m == total, so once back in the pool it is selected again: some
+        # post-recovery round must have counted all 3 results
+        assert any(r["n_received"] == 3 for r in h.server.history[2:]), \
+            f"recovered client never re-selected: {h.server.history}"
+    finally:
+        h.close()
+
+
+def test_killed_client_restarts_and_rejoins_midrun():
+    """Kill a client mid-run and restart it on the same rank: the restarted
+    incarnation re-attaches (stale mailbox frames are fenced by the round
+    echo) and participates again; the run completes fully."""
+    h = SiloSoakHarness(n_clients=2, rounds=4,
+                        server_kw=dict(round_timeout=5.0, quorum_frac=0.5),
+                        client_kw=dict(server_timeout_s=0.5, reattach=True))
+    try:
+        h.start_all()
+        assert h.wait_history(1, timeout=60)
+        h.kill_client(2)
+        h.start_client(2)
+        assert h.wait_done(timeout=90)
+        assert h.server.error is None
+        assert [r["round"] for r in h.server.history] == list(range(4))
+        # the restarted client participated post-restart
+        assert h.server.history[-1]["n_received"] == 2
+    finally:
+        h.close()
+
+
+# ------------------------------------------------ bounded failure surfaces
+def test_quorum_unreachable_fails_loudly():
+    """The below-quorum timeout re-arm loop is BOUNDED: max_rearms
+    exhausted -> run fails with a clear error + counter instead of
+    re-arming forever (the reference's silent eternal hang)."""
+    run = "t-quorum-bounded"
+    model = hub.create("lr", 3)
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    srv = FedServerManager(
+        FedCommManager(LoopbackTransport(0, run), 0), client_ids=[1],
+        init_params=params, num_rounds=2, round_timeout=0.1, max_rearms=2)
+    stub = FedCommManager(LoopbackTransport(1, run), 1)
+    stub.register_message_receive_handler(
+        md.S2C_CHECK_CLIENT_STATUS,
+        lambda m: stub.send_message(
+            Message(md.C2S_CLIENT_STATUS, 1, 0)
+            .add(md.KEY_STATUS, md.STATUS_ONLINE)))
+    for t in (md.S2C_INIT_CONFIG, md.S2C_FINISH):
+        stub.register_message_receive_handler(t, lambda m: None)
+    before = mx.snapshot()["counters"].get("fed.server.quorum_unreachable", 0)
+    try:
+        srv.run(background=True)
+        stub.run(background=True)
+        stub.send_message(Message(md.CONNECTION_IS_READY, 1, 0))
+        assert srv.done.wait(20), "bounded re-arm never declared failure"
+        assert srv.error and "quorum unreachable" in srv.error
+        assert mx.snapshot()["counters"]["fed.server.quorum_unreachable"] \
+            == before + 1
+    finally:
+        stub.stop()
+        release_router(run)
+
+
+def _mk_trainer(model, seed=0):
+    rs = np.random.RandomState(seed)
+    t = TrainArgs(epochs=1, batch_size=8)
+    return SiloTrainer(model.apply, t,
+                       rs.randn(16, 8).astype(np.float32),
+                       rs.randint(0, 3, 16).astype(np.int32), seed=seed)
+
+
+def test_client_server_silence_exits_nonzero():
+    """A client whose server died pre-FINISH exits with error set (and a
+    foreground run() raises -> nonzero process exit) instead of blocking in
+    the receive loop forever."""
+    run = "t-silence-exit"
+    model = hub.create("lr", 3)
+    c = FedClientManager(
+        FedCommManager(LoopbackTransport(5, run), 5), 5, _mk_trainer(model),
+        server_timeout_s=0.3, reattach=False)
+    raised = []
+
+    def fg():
+        try:
+            c.run(background=False)
+            raised.append(None)
+        except RuntimeError as e:
+            raised.append(str(e))
+
+    th = threading.Thread(target=fg, daemon=True)
+    th.start()
+    c.announce_ready()
+    assert c.done.wait(10), "watchdog never fired"
+    th.join(10)
+    assert c.error and "server silent" in c.error
+    assert raised and raised[0], "foreground run() did not raise"
+    release_router(run)
+
+
+def test_watchdog_ignores_local_training_time():
+    """Local training longer than server_timeout_s is OUR work, not server
+    silence — the watchdog must not declare a live server dead (or exit)
+    mid-round."""
+    class SlowTrainer:
+        n_samples = 1
+
+        def train(self, params, r):
+            time.sleep(0.7)
+            return params, 1, {}
+
+    run = "t-busy-train"
+    c = FedClientManager(
+        FedCommManager(LoopbackTransport(9, run), 9), 9, SlowTrainer(),
+        server_timeout_s=0.2, reattach=False)
+    try:
+        c.run(background=True)
+        c._on_init(Message(md.S2C_INIT_CONFIG, 0, 9)
+                   .add(md.KEY_MODEL_PARAMS, {"w": np.zeros(2)})
+                   .add(md.KEY_ROUND, 0))     # blocks ~0.7s training
+        assert c.error is None and not c.done.is_set(), \
+            f"watchdog fired during local training: {c.error}"
+    finally:
+        c._stopped.set()
+        c.comm.stop()
+        release_router(run)
+
+
+def test_chaos_soak_accepts_empty_schedule(tmp_path):
+    """A FaultSpec with no silo_kill entries is a no-kill baseline run,
+    not a TypeError."""
+    out = chaos_kill_soak(FaultSpec(), str(tmp_path / "ck"), n_clients=2,
+                          rounds=2)
+    assert out["kills"] == [] and out["error"] is None
+    assert [h["round"] for h in out["history"]] == [0, 1]
+
+
+def test_client_reattach_reannounces_and_budget_refunds():
+    """With reattach=True the watchdog re-announces instead of exiting; a
+    real server response refunds the attempt budget (a slow-but-live
+    server must never be declared dead by accumulation)."""
+    run = "t-reattach"
+    model = hub.create("lr", 3)
+    got = []
+    stub = FedCommManager(LoopbackTransport(0, run), 0)
+    stub.register_message_receive_handler(
+        md.CONNECTION_IS_READY, lambda m: got.append(time.monotonic()))
+    stub.register_message_receive_handler(md.C2S_HEARTBEAT, lambda m: None)
+    c = FedClientManager(
+        FedCommManager(LoopbackTransport(7, run), 7), 7, _mk_trainer(model),
+        server_timeout_s=0.2, reattach=True, max_reattach=3)
+    try:
+        stub.run(background=True)
+        c.run(background=True)
+        c.announce_ready()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(got) < 3:
+            time.sleep(0.02)
+        assert len(got) >= 3, "watchdog never re-announced"
+        assert not c.done.is_set()
+        # budget refund: a server contact resets the attempt counter
+        assert c._reattach_count >= 2
+        c._on_check_status(Message(md.S2C_CHECK_CLIENT_STATUS, 0, 7))
+        assert c._reattach_count == 0
+    finally:
+        c._stopped.set()
+        c.comm.stop()
+        stub.stop()
+        release_router(run)
+
+
+# ------------------------------------------------- secagg × resume contract
+def _secagg_pair(run_id, ckpt=None, resume=False, rounds=3):
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.3,
+                  client_num_in_total=2, client_num_per_round=2,
+                  comm_round=rounds)
+
+    def trainer(seed):
+        rs = np.random.RandomState(seed)
+        w = rs.randn(8, 3)
+        x = rs.randn(64, 8).astype(np.float32)
+        y = np.argmax(x @ w, axis=1).astype(np.int32)
+        return SiloTrainer(model.apply, t, x, y, seed=seed)
+
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    srv = SecAggServerManager(
+        FedCommManager(LoopbackTransport(0, run_id), 0), client_ids=[1, 2],
+        init_params=params, num_rounds=rounds, checkpoint_dir=ckpt,
+        resume=resume, round_timeout=10.0)
+    clients = [
+        SecAggClientManager(
+            FedCommManager(LoopbackTransport(cid, run_id), cid), cid,
+            trainer(cid), num_clients=2, client_ids=[1, 2])
+        for cid in (1, 2)]
+    return srv, clients
+
+
+def test_secagg_round_boundary_resume_bitwise(tmp_path):
+    """Server kill + round-boundary resume under secagg: surviving clients
+    keep their key material, the restarted round re-masks with the same
+    round_salt, and the final params match an uninterrupted secagg run
+    bitwise. Every checkpoint on disk claims phase=boundary (one is never
+    written mid-secagg-round)."""
+    ckpt = str(tmp_path / "sa")
+    ref_srv, ref_clients = _secagg_pair("sa-ref-dur")
+    ref_srv.run(background=True)
+    for c in ref_clients:
+        c.run(background=True)
+        c.announce_ready()
+    assert ref_srv.done.wait(90)
+
+    srv, clients = _secagg_pair("sa-soak-dur", ckpt=ckpt)
+    srv.run(background=True)
+    for c in clients:
+        c.run(background=True)
+        c.announce_ready()
+    deadline = time.monotonic() + 60
+    while len(srv.history) < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.history, "no secagg round completed pre-kill"
+    # the in-process SIGKILL analog (same ordering as SiloSoakHarness:
+    # sever, drain the pump, then cancel timers)
+    srv.comm.transport.stop_receive_message()
+    if srv.comm._thread is not None:
+        srv.comm._thread.join(timeout=10)
+    with srv._lock:
+        srv._cancel_timer()
+    srv2 = SecAggServerManager(
+        FedCommManager(LoopbackTransport(0, "sa-soak-dur"), 0),
+        client_ids=[1, 2], init_params=jax.tree.map(np.zeros_like,
+                                                    srv.params),
+        num_rounds=3, checkpoint_dir=ckpt, resume=True, round_timeout=10.0)
+    # NO client re-announce here: the resumed server must INITIATE the
+    # re-handshake itself (secagg clients have no watchdog to lean on)
+    srv2.run(background=True)
+    assert srv2.done.wait(90), "resumed secagg run did not finish"
+    assert srv2.error is None
+    assert [h["round"] for h in srv2.history] == [0, 1, 2]
+    assert _bitwise_equal(ref_srv.params, srv2.params)
+    # the on-disk contract: every checkpoint is a boundary checkpoint
+    from fedml_tpu.utils.checkpoint import read_meta
+
+    for name in os.listdir(ckpt):
+        r = int(name.split("_")[1])
+        extra = read_meta(ckpt, r)["extra"]
+        assert extra["kind"] == "secagg_server"
+        assert extra["phase"] == "boundary"
+    for cm in clients:
+        cm.done.wait(10)
+    release_router("sa-ref-dur")
+    release_router("sa-soak-dur")
+
+
+def test_secagg_resume_refuses_foreign_and_midround_checkpoints(tmp_path):
+    """The pinned refusals: a non-secagg checkpoint (no protocol state) and
+    a crafted checkpoint claiming a mid-round phase both refuse resume with
+    a clear error, not an orbax traceback."""
+    from fedml_tpu.utils.checkpoint import save_checkpoint
+
+    model = hub.create("lr", 3)
+    params = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    # (a) plain-server checkpoint into the secagg server
+    plain = str(tmp_path / "plain")
+    save_checkpoint(plain, 0, {"params": params},
+                    extra={"kind": "cross_silo_server", "generation": 0})
+    with pytest.raises(ValueError, match="non-secagg|cross_silo_server"):
+        SecAggServerManager(
+            FedCommManager(LoopbackTransport(0, "sa-refuse-a"), 0),
+            client_ids=[1, 2], init_params=params, num_rounds=3,
+            checkpoint_dir=plain, resume=True)
+    # (b) crafted mid-round phase
+    crafted = str(tmp_path / "crafted")
+    save_checkpoint(crafted, 1, {"params": params},
+                    extra={"kind": "secagg_server", "phase": "unmask",
+                           "threshold": 1, "q_bits": 16, "pks": {},
+                           "client_counts": {}, "weight_norm": 1.0,
+                           "active": [1, 2], "dropped_sk": {}})
+    with pytest.raises(ValueError, match="round-boundary only"):
+        SecAggServerManager(
+            FedCommManager(LoopbackTransport(0, "sa-refuse-b"), 0),
+            client_ids=[1, 2], init_params=params, num_rounds=3,
+            checkpoint_dir=crafted, resume=True)
+    release_router("sa-refuse-a")
+    release_router("sa-refuse-b")
+
+
+# --------------------------------------------------- config + runner wiring
+def test_config_validates_durability_knobs(tmp_path):
+    base = {"common_args": {"training_type": "cross_silo"}}
+
+    def cfg(**extra):
+        d = dict(base)
+        d["train_args"] = {"client_num_in_total": 2,
+                           "client_num_per_round": 2, "extra": extra}
+        return Config.from_dict(d)
+
+    cfg(checkpoint_dir=str(tmp_path), resume=True,
+        heartbeat_s=1.0, liveness_timeout_s=5.0, server_timeout_s=30.0,
+        max_rearms=3, quorum_frac=0.5)    # all valid
+    with pytest.raises(ValueError, match="resume requires checkpoint_dir"):
+        cfg(resume=True)
+    with pytest.raises(ValueError, match="heartbeat_s"):
+        cfg(heartbeat_s=-1)
+    with pytest.raises(ValueError, match="liveness_timeout_s"):
+        cfg(liveness_timeout_s="soon")
+    with pytest.raises(ValueError, match="quorum_frac"):
+        cfg(quorum_frac=1.5)
+    with pytest.raises(ValueError, match="max_rearms"):
+        cfg(max_rearms=0)
+    with pytest.raises(ValueError, match="resume must be a boolean"):
+        cfg(checkpoint_dir=str(tmp_path), resume="yes")
+    # chaos-plane silo_kill schedule validation
+    FaultSpec(silo_kill={0: 2, 1: 0})
+    with pytest.raises(ValueError, match="silo_kill"):
+        FaultSpec(silo_kill={0: -1})
+    with pytest.raises(ValueError, match="silo_kill"):
+        FaultSpec(silo_kill=[0])
+    assert FaultSpec.from_dict(
+        {"silo_kill": {"0": 2}}).silo_kill == {0: 2}
+
+
+def test_runner_wires_durability_knobs(tmp_path):
+    from fedml_tpu.runner import FedMLRunner
+
+    model = hub.create("lr", 3)
+    cfg = Config.from_dict({
+        "common_args": {"training_type": "cross_silo"},
+        "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 3,
+                       "extra": {"checkpoint_dir": str(tmp_path / "ck"),
+                                 "checkpoint_every": 2, "checkpoint_keep": 5,
+                                 "resume": True,
+                                 "liveness_timeout_s": 9.0, "max_rearms": 4,
+                                 "server_timeout_s": 7.0, "heartbeat_s": 2.0,
+                                 "run_id": "wire-dur"}},
+        "comm_args": {"extra": {"transport": "loopback",
+                                "run_id": "wire-dur"}},
+    })
+    srv = FedMLRunner(cfg, model=model, role="server",
+                      input_shape=(8,)).runner
+    assert isinstance(srv, FedServerManager)
+    assert srv.checkpoint_dir == str(tmp_path / "ck")
+    assert srv.checkpoint_every == 2 and srv.checkpoint_keep == 5
+    assert srv.liveness_timeout_s == 9.0 and srv.max_rearms == 4
+    rs = np.random.RandomState(0)
+    cli = FedMLRunner(cfg, dataset=(rs.randn(16, 8).astype(np.float32),
+                                    rs.randint(0, 3, 16).astype(np.int32)),
+                      model=model, role="client", rank=1).runner
+    assert isinstance(cli, FedClientManager)
+    assert cli.server_timeout_s == 7.0 and cli.heartbeat_s == 2.0
+    assert cli.reattach is True      # implied by resume
+    # an EXPLICIT checkpoint_every: 0 (cadence disabled) must survive the
+    # runner plumbing, not be coerced back to every-round
+    cfg0 = Config.from_dict({
+        "common_args": {"training_type": "cross_silo"},
+        "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                       "extra": {"checkpoint_dir": str(tmp_path / "ck0"),
+                                 "checkpoint_every": 0,
+                                 "run_id": "wire-dur0"}},
+        "comm_args": {"extra": {"transport": "loopback",
+                                "run_id": "wire-dur0"}},
+    })
+    srv0 = FedMLRunner(cfg0, model=model, role="server",
+                       input_shape=(8,)).runner
+    assert srv0.checkpoint_every == 0
+    release_router("wire-dur")
+    release_router("wire-dur0")
+
+
+# ------------------------------------------------------------ observability
+def test_top_renders_silo_line():
+    from fedml_tpu.__main__ import _top_frame
+
+    snap = {"counters": {"fed_server_resumes_total": 1,
+                         "fed_server_checkpoints_total": 4,
+                         "fed_server_evicted_total": 2,
+                         "fed_server_rejoins_total": 1,
+                         "fed_server_stale_gen_rejected_total": 3},
+            "gauges": {"fed_server_clients_online": 2,
+                       "fed_server_clients_total": 3,
+                       "fed_server_generation": 1},
+            "histograms": {}}
+    frame = _top_frame(snap, "test")
+    silo = [l for l in frame.splitlines() if l.startswith("silo:")]
+    assert silo, frame
+    line = silo[0]
+    assert "online 2/3" in line and "gen 1" in line
+    assert "resumes 1" in line and "evicted 2" in line
+    assert "stale_gen 3" in line
