@@ -869,6 +869,186 @@ def bench_serving_paged(quick: bool = False) -> dict:
     }
 
 
+def bench_serving_kernel(quick: bool = False) -> dict:
+    """Pallas paged-attention kernel row (ISSUE 11, leg 1): decode
+    step tok/s at LONG context through the paged engine with the fused
+    kernel (ops/paged_attention.py — pages read in place via the page
+    table) vs the XLA gather path (pages copied into a virtually-
+    contiguous sequence every token), tokens asserted identical.
+
+    Figure semantics by backend: on TPU the kernel elides one full
+    context copy per token per layer and the acceptance bar is >= 1.5x
+    at long context; on CPU the kernel runs in INTERPRET mode (the
+    correctness oracle tier-1 pins ride), where the per-grid-step
+    interpreter loop makes it SLOWER than gather — the CPU ratio is
+    recorded as a correctness artifact, not a performance claim (the
+    config string says which lane produced it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.llm.transformer import TransformerLM
+    from fedml_tpu.serving.engine import DecodeEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    conc, new, max_len, ps = 4, 12, 128, 16
+    if quick:
+        dims = dict(vocab_size=128, d_model=128, n_layers=2, n_heads=4,
+                    d_ff=256)
+    else:
+        dims = dict(vocab_size=256, d_model=256, n_layers=2, n_heads=8,
+                    d_ff=512)
+    model = TransformerLM(**dims, scan_layers=True)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rs = np.random.RandomState(0)
+    # long prompts: the gather path's per-token copy scales with these
+    prompts = [rs.randint(1, dims["vocab_size"], n).tolist()
+               for n in (112, 104, 96, 108)]
+
+    def run(kernel):
+        eng = DecodeEngine(model, params, n_slots=conc, max_len=max_len,
+                           page_size=ps, prefill_chunk=32,
+                           paged_kernel=kernel).start()
+        try:
+            eng.submit(prompts[0], new).result(timeout=600)   # compile
+            best, toks = 0.0, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                tickets = [eng.submit(p, new) for p in prompts]
+                outs = [t.result(timeout=600) for t in tickets]
+                best = max(best, conc * new / (time.perf_counter() - t0))
+                toks = outs
+        finally:
+            eng.stop()
+        return best, toks
+
+    # interleaved best-of so machine noise hits both variants alike
+    gather_tps, gather_toks = run(kernel=False)
+    kernel_tps, kernel_toks = run(kernel=True)
+    g2, _ = run(kernel=False)
+    k2, _ = run(kernel=True)
+    gather_tps, kernel_tps = max(gather_tps, g2), max(kernel_tps, k2)
+    return {
+        "serving_paged_kernel_tokens_per_sec": round(kernel_tps, 1),
+        "serving_paged_kernel_gather_tokens_per_sec": round(gather_tps, 1),
+        "serving_paged_kernel_ratio_vs_gather": round(
+            kernel_tps / gather_tps, 2),
+        "serving_paged_kernel_tokens_identical": kernel_toks == gather_toks,
+        "serving_paged_kernel_config": (
+            f"conc{conc} new{new} maxlen{max_len} page{ps} "
+            f"prompts~104 d{dims['d_model']} L{dims['n_layers']} "
+            f"H{dims['n_heads']}"
+            + (" quick" if quick else "")
+            + ("; TPU Mosaic lane, bar >=1.5x at long context"
+               if on_tpu else
+               "; CPU INTERPRET lane — correctness-only figure, the "
+               "kernel's perf claim is the TPU lane (bar >=1.5x)")),
+    }
+
+
+def bench_serving_spec(quick: bool = False) -> dict:
+    """Speculative-decoding row (ISSUE 11, leg 2): time-between-tokens
+    p50 (the serving.tbt histogram, delta over this run) with n-gram
+    self-drafted speculation ON vs OFF on acceptance-friendly traffic —
+    highly repetitive prompts whose greedy continuations loop, the
+    code/template/retrieval-echo shape prompt-lookup exists for — plus
+    the measured accept rate. Every accepted draft removes one full
+    per-token engine iteration (dispatch + one forward), which is the
+    whole per-token latency bill; acceptance bar: >= 1.5x TBT p50 on
+    this traffic (CPU and TPU alike — the win is iteration count, not
+    FLOPs), with adversarial-entropy traffic documented as the
+    leave-it-off case (accept rate ~0 makes every window pay
+    spec_k + 1 queries for one token)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.llm.transformer import TransformerLM
+    from fedml_tpu.serving.engine import DecodeEngine
+    from fedml_tpu.utils import metrics as _mx
+
+    conc, new, spec_k = 4, 24, 4
+    # deliberately SMALL dims: speculation's win is iteration-count
+    # reduction, which translates to TBT exactly when per-iteration cost
+    # is flat in window width — true on TPU (decode is a memory-bound
+    # weight sweep; +spec_k queries ride along free) and true on CPU
+    # only while dispatch overhead dominates FLOPs. Bigger CPU models go
+    # FLOP-bound on the verify window and the ratio sags toward the
+    # iteration-ratio/window-cost quotient — a CPU artifact the TPU lane
+    # does not share; the row's job here is the contract (identity,
+    # accept rate) plus an honest small-model latency figure.
+    dims = dict(vocab_size=128, d_model=48, n_layers=2, n_heads=4,
+                d_ff=96)
+    model = TransformerLM(**dims, scan_layers=True)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+
+    def mk(spec):
+        return DecodeEngine(
+            model, params, n_slots=conc, max_len=64, page_size=8,
+            prefill_chunk=16, spec_decode="ngram" if spec else "off",
+            spec_k=spec_k).start()
+
+    # ---- acceptance-friendly traffic, SELECTED not assumed: run a
+    # candidate sweep through the speculation-off engine and keep the
+    # prompts whose greedy continuations are most self-repetitive (the
+    # code/template/retrieval-echo shape prompt-lookup exists for).
+    # Deterministic: greedy decode of fixed prompts.
+    eng_off = mk(spec=False)
+    cands = [[t] * 24 for t in range(1, 17 if quick else 33)]
+    outs = [t.result(timeout=600)
+            for t in [eng_off.submit(p, new) for p in cands]]
+    score = lambda o: sum(a == b for a, b in zip(o, o[1:]))  # noqa: E731
+    prompts = [c for c, _o in sorted(
+        zip(cands, outs), key=lambda co: -score(co[1]))[:conc]]
+
+    eng_on = mk(spec=True)
+    c0 = _mx.snapshot()["counters"]
+    try:
+        eng_on.submit(prompts[0], new).result(timeout=600)   # compile
+        best = {False: None, True: None}
+        toks: dict = {}
+        # interleaved best-of-3: this box's wall clock swings +-30%,
+        # and the comparison must not eat a one-sided swing
+        for _ in range(2 if quick else 3):
+            for spec, eng in ((False, eng_off), (True, eng_on)):
+                tickets = [eng.submit(p, new) for p in prompts]
+                toks[spec] = [t.result(timeout=600) for t in tickets]
+                # per-request mean time-between-tokens, p50 across
+                # requests — the serving.tbt quantity measured off the
+                # tickets directly (histogram buckets are too coarse
+                # for sub-ms CPU deltas)
+                tbt = float(np.median([
+                    (t.t_done - t.t_first) / (new - 1) for t in tickets]))
+                best[spec] = (tbt if best[spec] is None
+                              else min(best[spec], tbt))
+    finally:
+        eng_off.stop()
+        eng_on.stop()
+    c1 = _mx.snapshot()["counters"]
+    prop = c1.get("serving.spec.proposed", 0) - c0.get(
+        "serving.spec.proposed", 0)
+    accepted = c1.get("serving.spec.accepted", 0) - c0.get(
+        "serving.spec.accepted", 0)
+    return {
+        "serving_spec_tbt_p50_ms_on": round(best[True] * 1e3, 3),
+        "serving_spec_tbt_p50_ms_off": round(best[False] * 1e3, 3),
+        "serving_spec_tbt_speedup": round(best[False] / best[True], 2),
+        "serving_spec_accept_rate": round(accepted / max(prop, 1), 3),
+        "serving_spec_tokens_identical": toks[True] == toks[False],
+        "serving_spec_config": (
+            f"conc{conc} new{new} spec_k{spec_k} selected repetitive "
+            f"traffic d{dims['d_model']} L{dims['n_layers']} maxlen64 "
+            "page8"
+            + (" quick" if quick else "")
+            + "; bar >=1.5x TBT p50 on acceptance-friendly traffic "
+              "(memory/dispatch-bound regime; larger CPU models go "
+              "FLOP-bound on the verify window); adversarial-entropy "
+              "traffic: leave spec off"),
+    }
+
+
 def bench_serving_fleet(quick: bool = False) -> dict:
     """Serving-fleet robustness rows (ISSUE 9) over a 2-replica
     engine-backed LM deployment behind the gateway:
@@ -1668,6 +1848,12 @@ _HEADLINE_KEYS = (
     "serving_paged_ttft_p99_ms_chunked",
     "serving_paged_ttft_p99_ms_monolithic",
     "serving_paged_prefix_hit_flatness_224_over_64",
+    # decode raw speed (ISSUE 11): fused paged-attention kernel +
+    # speculative decoding
+    "serving_paged_kernel_ratio_vs_gather",
+    "serving_paged_kernel_tokens_identical",
+    "serving_spec_tbt_speedup", "serving_spec_accept_rate",
+    "serving_spec_tokens_identical",
     # serving-fleet robustness (ISSUE 9): rolling swap + shed + stream
     "serving_fleet_rolling_non2xx", "serving_fleet_rolling_requests",
     "serving_fleet_shed_429s", "serving_fleet_shed_p99_ratio",
@@ -1740,6 +1926,11 @@ def main():
                {"serving_cb_error": "bench_serving_cb failed twice"})
     acc.update(_retrying(bench_serving_paged, quick, default=None) or
                {"serving_paged_error": "bench_serving_paged failed twice"})
+    acc.update(_retrying(bench_serving_kernel, quick, default=None) or
+               {"serving_paged_kernel_error":
+                "bench_serving_kernel failed twice"})
+    acc.update(_retrying(bench_serving_spec, quick, default=None) or
+               {"serving_spec_error": "bench_serving_spec failed twice"})
     acc.update(_retrying(bench_serving_fleet, quick, default=None) or
                {"serving_fleet_error": "bench_serving_fleet failed twice"})
     acc.update(_retrying(bench_sim_scale, quick, default=None) or

@@ -510,6 +510,13 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             miss = int(c.get("serving_prefix_misses_total", 0))
             if hits + miss:
                 seg += f"  prefix {hits / (hits + miss) * 100:.0f}%"
+            # speculative decoding (serving/engine.py spec_decode):
+            # accepted draft tokens / proposed — the knob that says
+            # whether speculation is paying for its verify windows
+            prop = int(c.get("serving_spec_proposed_total", 0))
+            if prop:
+                acc = int(c.get("serving_spec_accepted_total", 0))
+                seg += f"  spec {acc / prop * 100:.0f}%"
             for label, key in (("ttft", "serving_ttft"),
                                ("tbt", "serving_tbt")):
                 hh = h.get(key)
@@ -952,6 +959,73 @@ def cmd_diagnosis(args) -> int:
                 "pages_free": int(free), "prefix_resident": resident,
                 "programs": counts}
 
+    def serving_spec_smoke():
+        # the decode-speed plane end-to-end (ISSUE 11): 4 concurrent
+        # requests with repetitive (acceptance-friendly) prompts through
+        # the PAGED engine with n-gram speculation on — drafts must
+        # actually be accepted (accepted > 0), the emitted tokens must be
+        # token-identical to the same engine with speculation off (the
+        # greedy-exact contract), and the compiled-program set must stay
+        # bounded (ONE verify window program, zero plain-step programs).
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from .llm.transformer import TransformerLM
+        from .serving.engine import DecodeEngine
+        from .utils import metrics as mx
+
+        model = TransformerLM(vocab_size=64, d_model=32, n_layers=1,
+                              n_heads=2, d_ff=64, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        # repetitive prompts: the trailing bigram always has an earlier
+        # occurrence, so the self-draft proposes the loop's continuation.
+        # All length 8 = exactly two 4-token chunks — ONE chunk program
+        # per engine; this probe runs twice inside tier-1, keep it lean
+        prompts = [[3, 9] * 4, [2] * 8, [11, 5, 7, 11, 5, 7, 11, 5],
+                   [7] * 8]
+
+        def run(spec):
+            eng = DecodeEngine(
+                model, params, n_slots=4, max_len=32, page_size=4,
+                prefill_chunk=4, spec_decode="ngram" if spec else "off",
+                spec_k=3).start()
+            try:
+                tickets = [eng.submit(p, 6) for p in prompts]
+                outs = [t.result(timeout=60) for t in tickets]
+                return outs, eng.program_counts()
+            finally:
+                eng.stop()
+
+        base, _counts = run(spec=False)
+        # DELTA across the spec run, not process-lifetime absolutes — an
+        # earlier spec engine in this process (tier-1 runs this probe
+        # in-process) must not satisfy the accepted>0 bar for it
+        c0 = mx.snapshot()["counters"]
+        got, counts = run(spec=True)
+        c1 = mx.snapshot()["counters"]
+        accepted = int(c1.get("serving.spec.accepted", 0)
+                       - c0.get("serving.spec.accepted", 0))
+        proposed = int(c1.get("serving.spec.proposed", 0)
+                       - c0.get("serving.spec.proposed", 0))
+        if got != base:
+            raise ValueError(
+                "speculation-on output differs from speculation-off — "
+                "the greedy-exact acceptance contract is broken")
+        if accepted < 1:
+            raise ValueError(
+                f"no draft token was ever accepted on repetitive "
+                f"prompts (proposed {proposed})")
+        if counts.get("verify") not in (None, 1):
+            raise ValueError(f"verify program retraced: {counts}")
+        if counts["step"] not in (None, 0):
+            raise ValueError(
+                f"spec engine dispatched plain steps: {counts}")
+        return {"requests": len(prompts), "accepted": accepted,
+                "proposed": proposed,
+                "accept_rate": round(accepted / max(proposed, 1), 3),
+                "programs": counts}
+
     def fleet_rolling_update_smoke():
         # the serving-fleet robustness plane end-to-end (ISSUE 9): a
         # 2-replica engine-backed LM deployment under sustained
@@ -1127,12 +1201,14 @@ def cmd_diagnosis(args) -> int:
               "chaos_smoke": chaos_smoke,
               "serving_engine_smoke": serving_engine_smoke,
               "serving_paged_smoke": serving_paged_smoke,
+              "serving_spec_smoke": serving_spec_smoke,
               "fleet_rolling_update_smoke": fleet_rolling_update_smoke,
               "partition_rules_smoke": partition_rules_smoke,
               "cohort_sharded_smoke": cohort_sharded_smoke,
               "cross_silo_durability_smoke": cross_silo_durability_smoke}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
+                "serving_spec_smoke",
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
                 "cross_silo_durability_smoke")

@@ -165,6 +165,15 @@ class ServeArgs:
       engine_mp          — >1 runs the engine tensor-parallel over an
                           {"mp": N} mesh (weights + persistent KV cache
                           sharded via the parallel/partition.py registry)
+    Decode-speed knobs (ISSUE 11 — both need the paged engine,
+    kv_page_size > 0):
+      paged_kernel      — fused Pallas paged-attention decode kernel
+                          (ops/paged_attention.py): pages read in place,
+                          no gather copy
+      spec_decode       — "ngram" turns on greedy-exact self-drafted
+                          speculative decoding ("off" default)
+      spec_k            — draft tokens per speculative window (needs
+                          spec_decode: ngram)
     Fleet knobs (ISSUE 9 — serving/scheduler.py consumes them through
     scheduler.fleet_knobs; drain_timeout_s rides the predictor mapping):
       drain_timeout_s      — bound on stop(drain=True): how long in-flight
@@ -468,7 +477,8 @@ class Config:
                         "engine_fetch_chunk", "engine_eos_id",
                         "sampler_cache_size", "kv_cache", "engine_mp",
                         "kv_page_size", "kv_n_pages", "prefill_chunk",
-                        "prefix_cache", "drain_timeout_s", "shed_watermark",
+                        "prefix_cache", "paged_kernel", "spec_decode",
+                        "spec_k", "drain_timeout_s", "shed_watermark",
                         "retry_after_s", "probation_deadline_s",
                         "probe_backoff_s"}
         unknown = set(self.serve_args.extra) - _serve_knobs
@@ -550,6 +560,59 @@ class Config:
                     f"serve_args.{knob} requires kv_page_size > 0 (the "
                     "paged KV cache) — without paging the knob would be "
                     "silently ignored")
+        # decode-speed knobs (ISSUE 11): the Pallas paged-attention
+        # kernel and n-gram speculative decoding both live inside the
+        # PAGED engine — same gating discipline, a knob that would be
+        # silently ignored is refused at load
+        pk = self.serve_args.extra.get("paged_kernel")
+        if pk is not None and not isinstance(pk, bool):
+            raise ValueError(
+                f"serve_args.paged_kernel must be a boolean; got {pk!r}")
+        if pk and not self.serve_args.extra.get("kv_page_size"):
+            raise ValueError(
+                "serve_args.paged_kernel requires kv_page_size > 0 — the "
+                "fused kernel reads the paged KV pool in place; without "
+                "paging the knob would be silently ignored")
+        sd = self.serve_args.extra.get("spec_decode")
+        if sd is not None:
+            # YAML 1.1 reads an unquoted `off` as boolean False — that IS
+            # the documented disable spelling, so normalize it instead of
+            # rejecting the user's own docs back at them (True has no
+            # mode to normalize to: name the quoting problem)
+            if sd is False:
+                sd = self.serve_args.extra["spec_decode"] = "off"
+            if sd is True:
+                raise ValueError(
+                    "serve_args.spec_decode: true is not a mode — use "
+                    "'ngram' (YAML parses unquoted off/on as booleans; "
+                    "quote the value)")
+            if sd not in ("off", "ngram"):
+                raise ValueError(
+                    "serve_args.spec_decode must be 'off' or 'ngram'; "
+                    f"got {sd!r}")
+            if sd != "off" and not self.serve_args.extra.get(
+                    "kv_page_size"):
+                raise ValueError(
+                    "serve_args.spec_decode requires kv_page_size > 0 — "
+                    "speculative verify-and-rollback rides the paged KV "
+                    "cache's page table; without paging the knob would "
+                    "be silently ignored")
+        sk = self.serve_args.extra.get("spec_k")
+        if sk is not None:
+            try:
+                ok = (not isinstance(sk, bool)
+                      and int(sk) == float(sk) and int(sk) >= 1)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"serve_args.spec_k must be an integer >= 1; got "
+                    f"{sk!r}")
+            if sd in (None, "off"):
+                raise ValueError(
+                    "serve_args.spec_k requires spec_decode: ngram — "
+                    "the draft length only exists under speculation; "
+                    "without it the knob would be silently ignored")
         # partitioning-plane knobs (parallel/partition.py): the rule-table
         # name must exist in the registry and the unmatched policy must be
         # a known one — a typo'd table fails at load, not as an

@@ -232,7 +232,8 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
 
 
 def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
-                         dtype=jnp.float32, eps: float = 1e-6):
+                         dtype=jnp.float32, eps: float = 1e-6,
+                         kernel: bool = False, mesh=None):
     """Paged variant of make_kv_decode for the block-allocated engine
     cache (serving/engine.py): K/V live in a POOL of fixed-size pages
     `[L, n_pages, page_size, H, Dh]` instead of one contiguous
@@ -242,7 +243,7 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
     engine's HBM proportional to LIVE tokens (and lets identical prompt
     prefixes share physical pages) rather than `slots x max_len`.
 
-    Returns (chunk, step):
+    Returns (chunk, step, verify):
 
     chunk(params, adapters, cache, pages_row, tokens, t0, length)
         -> (cache, logits)     # ONE slot: process `length` prompt tokens
@@ -266,6 +267,21 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
                                # freed and re-allocated to ANOTHER slot,
                                # so "write lands on a frozen position" is
                                # no longer a safe place to park it.
+    verify(params, adapters, cache, pages, pos, tokens, active)
+        -> (cache, logits)     # ALL slots, C tokens each (tokens
+                               # [S, C] at positions pos..pos+C-1;
+                               # logits [S, C, V]) — the speculative-
+                               # decoding target forward: slot s's
+                               # query i attends everything <= pos[s]+i
+                               # INCLUDING this call's own K/V writes
+                               # at pos..pos+i, so logits[s, i] is the
+                               # true next-token distribution exactly
+                               # when tokens[s, 1..i] matched the
+                               # target's own picks (the greedy-exact
+                               # acceptance rule). Writes past the
+                               # slot's page-table reservation redirect
+                               # to the null page; step IS verify at
+                               # C == 1.
 
     Page 0 is the null/trash page by contract: never allocated to a
     request, it absorbs padded-position and inactive-slot writes; reads
@@ -274,7 +290,19 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
     into a virtually-contiguous [max_pages * page_size] sequence, so the
     math (and, pinned in tests, the greedy tokens) matches the contiguous
     cache — the gather is the XLA-level cost of paging; the win is that
-    the PERSISTENT pool holds only `n_pages * page_size` rows."""
+    the PERSISTENT pool holds only `n_pages * page_size` rows.
+
+    `kernel=True` swaps step/verify's gather-then-attend for the fused
+    Pallas paged-attention kernel (ops/paged_attention.py) that reads
+    each slot's pages IN PLACE via the device-side page table — no
+    virtually-contiguous copy, per-token attention HBM traffic goes from
+    O(2·context) to O(context). chunk (prefill) keeps the gather: its
+    cost is amortized over the whole prompt and the kernel is the
+    decode-side hot path. `mesh` (with an `mp` axis) shard_maps the
+    kernel over the heads axis — the same layout
+    partition.paged_kv_cache_spec pins on the pool, reaching the kernel
+    with zero resharding. Token identity vs the gather path is pinned in
+    tests/test_decode_kernel_spec.py."""
     ps = int(page_size)
 
     def norm(x, scale):
@@ -338,31 +366,77 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
         logits = head(params, top_ads, rank_scale, last[None, None])
         return {"k": ck, "v": cv}, logits[:, 0]
 
-    def step(params, adapters, cache, pages, pos, token, active):
+    if kernel:
+        from ..ops.paged_attention import paged_attention
+
+        attn_fused = paged_attention
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            try:  # newer jax exports shard_map at the top level
+                from jax import shard_map
+            except ImportError:
+                from jax.experimental.shard_map import shard_map
+
+            # heads are independent in attention, so the mp split of the
+            # pool (partition.paged_kv_cache_spec) reaches the kernel
+            # as-is: each device runs it over its own heads, the page
+            # table/positions replicated — no resharding, no collective
+            attn_fused = shard_map(
+                lambda q, kp, vp, pg, po: paged_attention(q, kp, vp, pg, po),
+                mesh=mesh,
+                in_specs=(P(None, None, "mp", None),
+                          P(None, None, "mp", None),
+                          P(None, None, "mp", None),
+                          P(None, None), P(None)),
+                out_specs=P(None, None, "mp", None), check_rep=False)
+
+    def verify(params, adapters, cache, pages, pos, tokens, active):
+        """C tokens per slot through one forward (C = tokens.shape[1];
+        C == 1 is the plain decode step). Query i of slot s sits at
+        global position pos[s] + i; its K/V write lands there BEFORE
+        attention, so the window attends to itself causally."""
         blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
         emb = dq(params["embed"]["embedding"])
-        x = emb[token][:, None, :]                        # [S, 1, D]
-        s_ = token.shape[0]
+        x = emb[tokens]                                   # [S, C, D]
+        s_, c = tokens.shape
         pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (s_,))
-        wpage = jnp.where(active, pages[jnp.arange(s_), pos // ps], 0)
-        woff = pos % ps
-        n_virt = pages.shape[1] * ps
+        posr = pos[:, None] + jnp.arange(c)               # [S, C]
+        max_pages = pages.shape[1]
+        rowidx = posr // ps
+        # positions past the slot's page-table reservation (speculative
+        # windows may overrun the token budget; those picks are
+        # discarded) and inactive slots' writes both redirect to the
+        # null page — a clamped row read could otherwise alias a REAL
+        # page of this slot
+        wpage = jnp.where(
+            active[:, None] & (rowidx < max_pages),
+            pages[jnp.arange(s_)[:, None], jnp.minimum(rowidx,
+                                                       max_pages - 1)], 0)
+        woff = posr % ps
+        n_virt = max_pages * ps
 
         def body(x, layer):
             bl, ad_l, ck, cv = layer
             h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
             q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
-            q = _rope_rows(q, pos[:, None])
-            k = _rope_rows(k, pos[:, None])
-            ck = ck.at[wpage, woff].set(k[:, 0])
-            cv = cv.at[wpage, woff].set(v[:, 0])
-            kk = ck[pages].reshape((s_, n_virt) + ck.shape[2:])
-            vv = cv[pages].reshape((s_, n_virt) + cv.shape[2:])
-            scale = q.shape[-1] ** -0.5
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
-            live = jnp.arange(n_virt)[None] <= pos[:, None]      # [S, T]
-            s = jnp.where(live[:, None, None, :], s, _NEG)
-            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vv)
+            q = _rope_rows(q, posr)
+            k = _rope_rows(k, posr)
+            ck = ck.at[wpage, woff].set(k)
+            cv = cv.at[wpage, woff].set(v)
+            if kernel:
+                # fused path: pages read in place by the Pallas kernel —
+                # no virtually-contiguous copy materializes
+                o = attn_fused(q, ck, cv, pages, pos)
+            else:
+                kk = ck[pages].reshape((s_, n_virt) + ck.shape[2:])
+                vv = cv[pages].reshape((s_, n_virt) + cv.shape[2:])
+                scale = q.shape[-1] ** -0.5
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+                live = (jnp.arange(n_virt)[None, None, :]
+                        <= posr[:, :, None])                 # [S, C, T]
+                s = jnp.where(live[:, None, :, :], s, _NEG)
+                o = jnp.einsum("bhqk,bkhd->bqhd",
+                               jax.nn.softmax(s, -1), vv)
             x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
                 bl, ad_l, "wo", rank_scale)
             x = mlp(bl, ad_l, rank_scale, x)
@@ -371,9 +445,54 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
         x, (ck, cv) = jax.lax.scan(
             body, x, (params["blocks"], blk_ads, cache["k"], cache["v"]))
         logits = head(params, top_ads, rank_scale, x)
-        return {"k": ck, "v": cv}, logits[:, 0]
+        return {"k": ck, "v": cv}, logits
 
-    return chunk, step
+    def step(params, adapters, cache, pages, pos, token, active):
+        cache, logits = verify(params, adapters, cache, pages, pos,
+                               token[:, None], active)
+        return cache, logits[:, 0]
+
+    return chunk, step, verify
+
+
+def ngram_propose(hist, pos, k: int, w: int = 2):
+    """Self-drafting n-gram / prompt-lookup proposer (in-jit, the draft
+    side of greedy-exact speculative decoding): for each slot, find the
+    most recent PREVIOUS occurrence of the trailing `w`-gram
+    `hist[pos-w+1 .. pos]` in that slot's own token history and propose
+    the `k` tokens that followed it. No draft model, no extra forward —
+    repetitive traffic (code, templates, retrieval echoes) is predicted
+    by its own past.
+
+    hist: [S, T] int32 token history; hist[s, :pos[s]+1] must be the
+    slot's true tokens (prompt + generated) — entries PAST pos may be
+    stale rejected drafts and are never trusted as match anchors, though
+    a continuation may run into them (drafts are proposals; the verify
+    forward decides, so a bad draft costs acceptance, never correctness).
+    pos: [S] position of the last known token. Returns [S, k] drafts;
+    slots with no match fall back to repeating their last token (the
+    self-loop draft — exactly right for the degenerate repetition case).
+    """
+    s_, t = hist.shape
+    idx = jnp.arange(t)[None, :]                          # [1, T]
+    # candidate continuation start j: positions j-w..j-1 hold the same
+    # w-gram as positions pos-w+1..pos; j must be a PAST point (<= pos)
+    # with a full gram before it (>= w)
+    match = (idx >= w) & (idx <= pos[:, None])
+    for shift in range(w):
+        a = jnp.take_along_axis(
+            hist, jnp.maximum(idx - 1 - shift, 0), axis=1)     # [S, T]
+        b = jnp.take_along_axis(
+            hist, jnp.maximum(pos[:, None] - shift, 0), axis=1)  # [S, 1]
+        match = match & (a == b)
+    found = jnp.any(match, axis=1)
+    # most recent occurrence wins (largest j): recency beats frequency
+    # for the loops/templates this draft exists to predict
+    j = jnp.max(jnp.where(match, idx, 0), axis=1)         # [S]
+    gidx = jnp.minimum(j[:, None] + jnp.arange(k), t - 1)
+    draft = jnp.take_along_axis(hist, gidx, axis=1)       # [S, k]
+    last = jnp.take_along_axis(hist, pos[:, None], axis=1)
+    return jnp.where(found[:, None], draft, last)
 
 
 def make_generate(n_heads: int, alpha: float = 16.0,

@@ -2,9 +2,14 @@
 
 The hot op of the FedLLM path. XLA's fused-attention pattern matching is
 good but opaque; this kernel makes the O(T) memory / blockwise-softmax
-schedule explicit (the pallas playbook, /opt/skills/guides/pallas_guide.md:
-VMEM block specs, online-softmax accumulators, fori_loop over K blocks with
-causal block skipping).
+schedule explicit. The blocking scheme, in full (so this doc stands on
+its own in any checkout): Q/K/V are tiled into (block, D) slabs mapped
+to VMEM by BlockSpec index maps over a (batch·head, q-block, k-block)
+grid; the softmax never sees a full row — a running max `m`, running
+normalizer `l`, and unnormalized output accumulator `o` live in VMEM
+scratch and are rescaled by exp(m_old - m_new) as each K block streams
+through (the online-softmax recurrence); fully-future K blocks under the
+causal mask are skipped with pl.when.
 
 Scope:
 - forward: 3-D grid (batch*head, q-block, k-block). K/V genuinely stream

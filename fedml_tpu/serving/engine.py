@@ -78,6 +78,28 @@ per-request path (pinned in tests/test_paged_engine.py), and the program
 set stays bounded: one paged step program + one chunk program per chunk
 bucket (log2(prefill_chunk) of them at most).
 
+DECODE RAW SPEED (ISSUE 11) — two paged-mode legs, both token-identity
+pinned (tests/test_decode_kernel_spec.py):
+
+- `paged_kernel=True` swaps the step's gather-then-attend for the fused
+  Pallas paged-attention kernel (ops/paged_attention.py): pages are read
+  IN PLACE through the device-side page table, the virtually-contiguous
+  copy never materializes, per-token attention HBM traffic halves. The
+  gather path stays as the test oracle; CPU runs the same kernel under
+  interpret mode, so tier-1 exercises the real kernel body.
+- `spec_decode="ngram"` attacks per-token latency itself: each
+  iteration self-drafts `spec_k` tokens from the slot's OWN history
+  (prompt-lookup n-gram — no second model), verifies the whole window
+  in ONE batched target forward over the paged cache, and accepts the
+  longest prefix the target itself would have produced. Greedy-exact by
+  construction (a token is only accepted when every input before it was
+  the target's own pick), and the same argument covers seeded sampling
+  because the per-position rng schedule is the plain step's. Rollback
+  is positional: pos advances only past accepted tokens, so the next
+  window re-writes rejected positions' pages before anything reads
+  them. Accept telemetry: `serving.spec.proposed` / `.accepted`
+  counters, accept-rate on the `top` engine line.
+
 Capacity contract per slot: `prompt_len + max_new_tokens <= max_len`
 (no step bucketing — the engine emits exactly the tokens asked for, so
 unlike the per-request path max_new_tokens is not rounded up). Paged
@@ -377,7 +399,14 @@ class DecodeEngine:
     (0 = whole prompt in one chunk), `prefix_cache` toggles content-hash
     prefix page reuse. Composes with `mesh` (pages replicate; the pool
     shards its heads axis). Paged greedy output is token-identical to
-    contiguous (pinned in tests/test_paged_engine.py)."""
+    contiguous (pinned in tests/test_paged_engine.py).
+
+    `paged_kernel=True` (paged only) runs decode attention through the
+    fused Pallas kernel (ops/paged_attention.py — pages read in place,
+    no gather copy); `spec_decode="ngram"` + `spec_k` (paged only) turns
+    each iteration into a self-drafted speculative verify window that
+    emits up to spec_k + 1 tokens, greedy-exact (module docstring).
+    Both compose with each other and with `mesh`."""
 
     def __init__(self, model, params: Pytree,
                  adapters: Optional[Pytree] = None, *,
@@ -386,10 +415,12 @@ class DecodeEngine:
                  dtype=None, fetch_chunk: int = 2,
                  mesh=None, partition_rules=None,
                  page_size: int = 0, n_pages: Optional[int] = None,
-                 prefill_chunk: int = 0, prefix_cache: bool = True):
+                 prefill_chunk: int = 0, prefix_cache: bool = True,
+                 paged_kernel: bool = False, spec_decode: str = "off",
+                 spec_k: int = 4):
         from ..llm.decode import (
-            make_kv_decode, make_paged_kv_decode, stack_adapter_blocks,
-            stack_blocks,
+            make_kv_decode, make_paged_kv_decode, ngram_propose,
+            stack_adapter_blocks, stack_blocks,
         )
 
         if n_slots < 1:
@@ -433,6 +464,31 @@ class DecodeEngine:
                 "kv_n_pages/prefill_chunk configure the PAGED cache — "
                 "set page_size > 0 (they would be silently ignored in "
                 "contiguous mode)")
+        # ------------------------------------------- decode-speed knobs
+        # Both legs live on the paged layout: the kernel reads the page
+        # pool in place, and speculation's verify-and-rollback rides the
+        # page table (rejected positions are re-written by the next
+        # verify window). Asking for either without paging would be
+        # silently ignored — refuse instead.
+        self._kernel_on = bool(paged_kernel)
+        if self._kernel_on and not self._paged:
+            raise ValueError(
+                "paged_kernel fuses attention over the PAGED KV pool — "
+                "set page_size > 0 (in contiguous mode the knob would be "
+                "silently ignored)")
+        if spec_decode not in ("off", "ngram"):
+            raise ValueError(
+                f"spec_decode must be 'off' or 'ngram'; got {spec_decode!r}")
+        self._spec_on = spec_decode == "ngram"
+        self._spec_k = int(spec_k)
+        if self._spec_on and not self._paged:
+            raise ValueError(
+                "spec_decode verifies draft windows over the PAGED KV "
+                "cache (write positions roll back through the page "
+                "table) — set page_size > 0")
+        if self._spec_on and self._spec_k < 1:
+            raise ValueError(
+                f"spec_k must be >= 1 draft tokens; got {spec_k}")
         self._admissions: deque[_Admission] = deque()
         # -1 never matches a token id, so eos retirement is inert
         self._eos = -1 if eos_id is None else int(eos_id)
@@ -496,8 +552,9 @@ class DecodeEngine:
                 mesh, jax.sharding.PartitionSpec())
 
         if self._paged:
-            chunk_fn, paged_step = make_paged_kv_decode(
-                model.n_heads, self._page_size, dtype=kv_dtype)
+            chunk_fn, paged_step, paged_verify = make_paged_kv_decode(
+                model.n_heads, self._page_size, dtype=kv_dtype,
+                kernel=self._kernel_on, mesh=mesh)
         else:
             prefill, step = make_kv_decode(model.n_heads, dtype=kv_dtype)
         S, eos, max_len_ = self.n_slots, self._eos, self.max_len
@@ -563,7 +620,7 @@ class DecodeEngine:
                 # not end it, and there is budget left (limit = plen +
                 # max_new - 1, as in contiguous mode)
                 active = final & (first != eos) & (plen < limit)
-                return {
+                out = {
                     "cache": cache,
                     "pages": pages,
                     "pos": carry["pos"].at[slot].set(plen),
@@ -572,7 +629,16 @@ class DecodeEngine:
                     "temp": carry["temp"].at[slot].set(temp),
                     "seed": carry["seed"].at[slot].set(seed),
                     "limit": carry["limit"].at[slot].set(limit),
-                }, first
+                }
+                if self._spec_on:
+                    # the chunk's real tokens land in the slot's history
+                    # row (the n-gram draft source); padded tail indices
+                    # point past max_len and are dropped by the scatter
+                    cidx = jnp.arange(tokens.shape[1])
+                    hidx = jnp.where(cidx < clen, t0 + cidx, max_len_)
+                    out["hist"] = carry["hist"].at[slot, hidx].set(
+                        tokens[0])
+                return out, first
 
             def _step_all(params, adapters, carry):
                 """Advance every slot one token. The active mask rides
@@ -583,8 +649,90 @@ class DecodeEngine:
                 cache, logits = paged_step(
                     params, adapters, carry["cache"], carry["pages"],
                     carry["pos"], carry["tok"], carry["active"])
-                return _decode_tail(carry, cache, logits,
-                                    extra={"pages": carry["pages"]})
+                extra = {"pages": carry["pages"]}
+                if self._spec_on:
+                    extra["hist"] = carry["hist"]
+                return _decode_tail(carry, cache, logits, extra=extra)
+
+            spec_c = self._spec_k + 1
+
+            def _spec_all(params, adapters, carry):
+                """Speculative iteration, ALL slots: self-draft spec_k
+                tokens from each slot's own history (ngram_propose),
+                verify the whole window [tok, d1..dk] in ONE target
+                forward over the paged cache, emit the longest prefix
+                the target itself would have produced. By construction
+                the emitted stream is token-identical to plain decode:
+                token i is only accepted when every input before it was
+                the target's own pick, so its logits — and therefore
+                its pick, greedy or seeded — are exactly the plain
+                path's. Rejected positions' K/V writes are garbage, and
+                the rollback is positional: pos advances only past
+                accepted tokens, so the NEXT window re-writes those
+                very pages before anything can attend to them."""
+                s_idx = jnp.arange(S)
+                pos, tok = carry["pos"], carry["tok"]
+                active, temp = carry["active"], carry["temp"]
+                # the current token is real history at its write position
+                # — anchor it before drafting so the trailing n-gram
+                # includes it. INACTIVE slots write nothing (index
+                # max_len drops): their pos/tok are stale, and a slot
+                # mid-chunked-admission shares this hist buffer — a
+                # stale write could corrupt the incoming prompt's
+                # history and poison its draft anchors (never its
+                # output; drafts are proposals)
+                hist = carry["hist"].at[
+                    s_idx, jnp.where(active, pos, max_len_)].set(tok)
+                drafts = ngram_propose(hist, pos, spec_c - 1)
+                inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+                widx = pos[:, None] + jnp.arange(spec_c)
+                # record the window inputs (accepted ones are permanent
+                # history; rejected ones sit past the new pos and are
+                # overwritten before the draft matcher can anchor on
+                # them); inactive slots and out-of-range indices drop
+                hist = hist.at[
+                    s_idx[:, None],
+                    jnp.where(active[:, None] & (widx < max_len_),
+                              widx, max_len_)].set(inputs)
+                cache, logits = paged_verify(
+                    params, adapters, carry["cache"], carry["pages"],
+                    pos, inputs, active)
+                # the SAME rng schedule as the plain step (fold_in at
+                # write-position + 1) — seeded sampling stays pinned
+                # across spec on/off
+                keys = jax.vmap(
+                    lambda s, p: jax.vmap(
+                        lambda q: jax.random.fold_in(
+                            jax.random.key(s), q + 1))(
+                                p + jnp.arange(spec_c)))(
+                                    carry["seed"], pos)
+                # THE pick (greedy/sampled select), vmapped over the
+                # window axis — one selection implementation, so the
+                # spec-on == spec-off identity can't drift from a
+                # future pick() edit
+                g = jax.vmap(pick, in_axes=(1, None, 1),
+                             out_axes=1)(logits, temp, keys)
+                # token i is emitted iff every input before it was the
+                # target's own pick, nothing before it ended the
+                # request, and the budget has room — the in-jit
+                # statement of greedy-exact acceptance
+                emits = [active]
+                for i in range(1, spec_c):
+                    emits.append(emits[-1]
+                                 & (inputs[:, i] == g[:, i - 1])
+                                 & (g[:, i - 1] != eos)
+                                 & (pos + i < carry["limit"]))
+                emit = jnp.stack(emits, axis=1)
+                n_acc = emit.sum(axis=1).astype(jnp.int32)
+                last = g[s_idx, jnp.maximum(n_acc - 1, 0)]
+                pos2 = jnp.where(active, pos + n_acc, pos)
+                tok2 = jnp.where(active, last, tok)
+                act2 = active & (pos2 < carry["limit"]) & (last != eos)
+                out = {"cache": cache, "pages": carry["pages"],
+                       "pos": pos2, "tok": tok2, "active": act2,
+                       "temp": temp, "seed": carry["seed"],
+                       "limit": carry["limit"], "hist": hist}
+                return out, (g, jnp.where(active, n_acc, 0))
         else:
             def _admit(params, adapters, carry, tokens, length, slot, temp,
                        seed, limit):
@@ -633,9 +781,12 @@ class DecodeEngine:
         # scalars-per-slot replicated): donation requires the output
         # buffer to reuse the input's layout, and an XLA-chosen resharding
         # would silently turn the in-place update into a full copy.
+        self._spec_jit = None
         if mesh is None:
             self._admit_jit = jax.jit(_admit, donate_argnums=(2,))
             self._step_jit = jax.jit(_step_all, donate_argnums=(2,))
+            if self._spec_on:
+                self._spec_jit = jax.jit(_spec_all, donate_argnums=(2,))
             carry_sh = None
         else:
             # ONE carry-layout dict, used for the jit out_shardings AND the
@@ -650,12 +801,19 @@ class DecodeEngine:
             }
             if self._paged:
                 carry_sh["pages"] = rep_sharding
+            if self._spec_on:
+                carry_sh["hist"] = rep_sharding
             self._admit_jit = jax.jit(
                 _admit, donate_argnums=(2,),
                 out_shardings=(carry_sh, rep_sharding))
             self._step_jit = jax.jit(
                 _step_all, donate_argnums=(2,),
                 out_shardings=(carry_sh, (rep_sharding, rep_sharding)))
+            if self._spec_on:
+                self._spec_jit = jax.jit(
+                    _spec_all, donate_argnums=(2,),
+                    out_shardings=(carry_sh,
+                                   (rep_sharding, rep_sharding)))
 
         head = model.d_model // model.n_heads
         if self._paged:
@@ -676,6 +834,14 @@ class DecodeEngine:
         if self._paged:
             self._carry["pages"] = jnp.zeros((S, self._max_pages),
                                              jnp.int32)
+        if self._spec_on:
+            # per-slot token history (prompt + generated): the draft
+            # source, written by admission chunks and the verify
+            # windows. Prefix-HIT positions are skipped by chunked
+            # prefill and may retain a previous occupant's tokens —
+            # draft anchors landing there cost acceptance, never
+            # correctness (the verify forward decides)
+            self._carry["hist"] = jnp.zeros((S, self.max_len), jnp.int32)
         if carry_sh is not None:
             # place the persistent carry on the mesh up front — every later
             # call donates it back in the same layout
@@ -887,8 +1053,12 @@ class DecodeEngine:
         + 1 buckets: chunks are prefill_chunk-sized except a final
         pow2-bucketed remainder)."""
         out = {}
-        for name, fn in (("step", self._step_jit),
-                         ("admit", self._admit_jit)):
+        pairs = [("step", self._step_jit), ("admit", self._admit_jit)]
+        if self._spec_jit is not None:
+            # spec mode replaces the step dispatch with ONE verify-window
+            # program; "step" then stays 0 and "verify" must stay 1
+            pairs.append(("verify", self._spec_jit))
+        for name, fn in pairs:
             try:
                 out[name] = fn._cache_size()
             except Exception:  # jax without the introspection hook
@@ -925,9 +1095,17 @@ class DecodeEngine:
                 admitting = {a.slot for a in self._admissions}
                 if any(s is not None and i not in admitting
                        for i, s in enumerate(self._slots)):
-                    self._carry, (toks, mask) = self._step_jit(
-                        self.params, self.adapters, self._carry)
-                    pending.append(("step", toks, mask))
+                    if self._spec_on:
+                        # one verify window advances every slot up to
+                        # spec_k + 1 tokens — the speculative analog of
+                        # the plain step, same dispatch-ahead contract
+                        self._carry, (toks, counts) = self._spec_jit(
+                            self.params, self.adapters, self._carry)
+                        pending.append(("spec", toks, counts))
+                    else:
+                        self._carry, (toks, mask) = self._step_jit(
+                            self.params, self.adapters, self._carry)
+                        pending.append(("step", toks, mask))
                 # drain: normally keep `fetch_chunk` frames in flight so
                 # host bookkeeping overlaps device steps; drain eagerly
                 # when requests are starved for a slot (a completion frees
@@ -1173,6 +1351,28 @@ class DecodeEngine:
             with recorder.span("serving.engine.fetch", kind="admit"):
                 tok = int(np.asarray(first))
             self._deliver(slot, tok, first=True)
+            _mx.set_gauge("serving.slots_active",
+                          sum(s is not None for s in self._slots))
+            return
+        if frame[0] == "spec":
+            # one verify window's yield: toks [S, spec_k+1] target picks,
+            # counts [S] accepted lengths (0 = slot was inert)
+            _kind, toks_dev, counts_dev = frame
+            with recorder.span("serving.engine.fetch", kind="spec"):
+                toks = np.asarray(toks_dev)
+                counts = np.asarray(counts_dev)
+            live = counts > 0
+            if live.any():
+                # every live slot consumed spec_k drafts and banked
+                # count - 1 beyond the guaranteed token — the accept
+                # rate `top` and the bench report
+                _mx.inc("serving.spec.proposed",
+                        int(live.sum()) * (toks.shape[1] - 1))
+                _mx.inc("serving.spec.accepted",
+                        int((counts[live] - 1).sum()))
+            for slot in np.nonzero(live)[0]:
+                for t in toks[slot, :counts[slot]]:
+                    self._deliver(int(slot), int(t), first=False)
             _mx.set_gauge("serving.slots_active",
                           sum(s is not None for s in self._slots))
             return

@@ -117,7 +117,8 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
     """THE serve-knob -> GreedyLMPredictor mapping (decode_slots,
     engine_max_len, engine_eos_id, engine_fetch_chunk, sampler_cache_size,
     kv_cache, engine_mp, kv_page_size, kv_n_pages, prefill_chunk,
-    prefix_cache, drain_timeout_s), shared by the config route
+    prefix_cache, paged_kernel, spec_decode, spec_k, drain_timeout_s),
+    shared by the config route
     (serving.lm_predictor_from_config reads Config.serve_args.extra) and
     the deploy route (scheduler.start_replica reads the spec's serve
     dict) — one mapping, so the two surfaces cannot drift."""
@@ -136,6 +137,12 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
         kv_n_pages=None if n_pages is None else int(n_pages),
         prefill_chunk=int(sv.get("prefill_chunk", 0)),
         prefix_cache=bool(sv.get("prefix_cache", True)),
+        paged_kernel=bool(sv.get("paged_kernel", False)),
+        # a YAML-1.1 deploy spec reads unquoted `off` as False — the
+        # documented disable spelling; normalize like config.validate
+        spec_decode=("off" if sv.get("spec_decode") in (None, False)
+                     else str(sv.get("spec_decode"))),
+        spec_k=int(sv.get("spec_k", 4)),
         drain_timeout_s=float(sv.get("drain_timeout_s", 30.0)))
 
 
@@ -222,7 +229,16 @@ class GreedyLMPredictor(_InstrumentedPredictor):
     prompt-prefix pages (engine module docstring has the full story);
     engine capacity then becomes the page budget, consulted through
     engine.admissible() so routing and the 400/degrade contracts follow
-    the real constraint."""
+    the real constraint.
+
+    paged_kernel=True / spec_decode="ngram" (+ spec_k) turn on the
+    paged engine's decode-speed legs (serving/engine.py: fused Pallas
+    paged attention; greedy-exact self-drafted speculation). Neither
+    changes routing or the degrade contract: both are token-identical
+    to the plain engine — speculation keeps the engine's per-position
+    rng schedule, so even seeded sampling degrades/surfaces exactly as
+    before (the per-request path's schedule is the one that differs,
+    which _must_surface_engine_failure already covers)."""
 
     def __init__(self, model, params: Pytree,
                  detokenize: Optional[Callable[[list[int]], str]] = None,
@@ -233,7 +249,9 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                  sampler_cache_size: int = 4, engine_fetch_chunk: int = 2,
                  engine_mp: int = 0, kv_page_size: int = 0,
                  kv_n_pages: Optional[int] = None, prefill_chunk: int = 0,
-                 prefix_cache: bool = True, drain_timeout_s: float = 30.0):
+                 prefix_cache: bool = True, paged_kernel: bool = False,
+                 spec_decode: str = "off", spec_k: int = 4,
+                 drain_timeout_s: float = 30.0):
         self.model = model
         self.params = params
         self.detokenize = detokenize
@@ -256,6 +274,15 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 "kv_page_size/kv_n_pages/prefill_chunk configure the "
                 "PAGED decode engine — they need decode_slots > 0 "
                 "(otherwise they would be silently ignored)")
+        if (paged_kernel or spec_decode != "off") and not kv_page_size:
+            # both decode-speed legs live on the paged layout (the
+            # kernel reads the page pool in place; speculation rolls
+            # write positions back through the page table) — without it
+            # they would be silently ignored
+            raise ValueError(
+                "paged_kernel/spec_decode need the PAGED engine "
+                "(kv_page_size > 0, which itself needs decode_slots) — "
+                "otherwise they would be silently ignored")
 
         if adapters is not None and not kv_cache:
             # the recompute path drives model.apply, which knows nothing of
@@ -353,7 +380,9 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                     fetch_chunk=engine_fetch_chunk, mesh=mesh,
                     page_size=kv_page_size, n_pages=kv_n_pages,
                     prefill_chunk=prefill_chunk,
-                    prefix_cache=prefix_cache).start()
+                    prefix_cache=prefix_cache,
+                    paged_kernel=paged_kernel, spec_decode=spec_decode,
+                    spec_k=spec_k).start()
             return
 
         # n_steps is a Python int at trace time (scan length must be
