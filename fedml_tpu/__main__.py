@@ -610,6 +610,33 @@ def cmd_top(args) -> int:
         return 0        # ^C is the documented way to stop a live watch
 
 
+def cmd_lint(args) -> int:
+    """graftlint — the repo-native static-analysis plane (ISSUE 13).
+    Machine-checks the invariants the review passes used to catch by
+    hand: donated-buffer discipline, retrace hazards, serve-knob drift,
+    metric-name consistency, lock discipline in serving/comm, in-trace
+    purity. Exit 0 = clean, 1 = findings, 2 = usage error. `--format
+    json` emits the stable schema external CI consumes (README "Static
+    analysis")."""
+    from .analysis import all_rules, render_json, render_text, run_lint
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.name}: {r.summary}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [t.strip() for t in args.rules.split(",") if t.strip()]
+    try:
+        findings, stats = run_lint(paths=args.paths or None, rules=rules)
+    except (ValueError, OSError) as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    print(render_json(findings, stats) if args.format == "json"
+          else render_text(findings, stats))
+    return 1 if findings else 0
+
+
 def _forced_2dev_subprocess(child_src: str, label: str,
                             timeout: int = 240) -> dict:
     """Run `child_src` in a fresh interpreter whose host CPU platform is
@@ -1138,6 +1165,31 @@ def cmd_diagnosis(args) -> int:
         return {"resolved_params": len(_jax.tree_util.tree_leaves(specs)),
                 **mesh_child, "mode": "forced-2-device subprocess"}
 
+    def lint_clean():
+        # the static-analysis plane end-to-end (ISSUE 13): graftlint over
+        # the whole package tree must report ZERO findings — the same gate
+        # tier-1 asserts and the Docker image build enforces. Pure-AST, so
+        # it costs ~1s of the battery; --only lint_clean re-checks it
+        # alone after a fix.
+        import time as _time
+
+        from .analysis import run_lint
+
+        t0 = _time.perf_counter()
+        findings, stats = run_lint()
+        dt = _time.perf_counter() - t0
+        if findings:
+            raise ValueError(
+                f"{len(findings)} graftlint finding(s); first: "
+                f"{findings[0].format()}")
+        if dt > 20:
+            raise RuntimeError(
+                f"tree scan took {dt:.1f}s (budget 20s) — the lint gate "
+                "is too slow for CI")
+        return {"files": stats["files"], "rules": len(stats["rules"]),
+                "suppressed": stats["suppressed"],
+                "scan_s": round(dt, 3)}
+
     def cross_silo_durability_smoke():
         # the crash-durability plane end-to-end (ISSUE 10): an in-process
         # loopback federation whose server is SIGKILL-severed mid-run (no
@@ -1205,13 +1257,14 @@ def cmd_diagnosis(args) -> int:
               "fleet_rolling_update_smoke": fleet_rolling_update_smoke,
               "partition_rules_smoke": partition_rules_smoke,
               "cohort_sharded_smoke": cohort_sharded_smoke,
-              "cross_silo_durability_smoke": cross_silo_durability_smoke}
+              "cross_silo_durability_smoke": cross_silo_durability_smoke,
+              "lint_clean": lint_clean}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
                 "serving_spec_smoke",
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
-                "cross_silo_durability_smoke")
+                "cross_silo_durability_smoke", "lint_clean")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)
@@ -1262,6 +1315,20 @@ def main(argv=None) -> int:
                     help="run only the named probe(s) — e.g. "
                          "`diagnosis --only chaos_smoke` re-checks one "
                          "failing probe without the full battery")
+    lint_p = sub.add_parser(
+        "lint", help="graftlint: repo-native static analysis "
+                     "(donation/retrace/knob/metric/lock/purity rules)")
+    lint_p.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to scan (default: the fedml_tpu "
+                             "package tree)")
+    lint_p.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json emits the stable CI schema")
+    lint_p.add_argument("--rules", default=None,
+                        help="comma-separated rule subset (see "
+                             "--list-rules)")
+    lint_p.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
     rp = sub.add_parser("report",
                         help="summarize a tracked run's telemetry "
                              "(spans, counters, trace pointer)")
@@ -1290,7 +1357,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     return {"version": cmd_version, "env": cmd_env, "run": cmd_run,
             "bench": cmd_bench, "launch": cmd_launch, "build": cmd_build,
-            "logs": cmd_logs, "diagnosis": cmd_diagnosis,
+            "logs": cmd_logs, "diagnosis": cmd_diagnosis, "lint": cmd_lint,
             "report": cmd_report, "top": cmd_top}[args.cmd](args)
 
 

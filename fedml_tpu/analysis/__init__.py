@@ -1,0 +1,36 @@
+"""graftlint — repo-native static analysis (ISSUE 13).
+
+Eleven PRs of review culture distilled into machine-checkable rules: the
+invariants every hand-review pass (PRs 5, 7, 9, 11) kept re-catching —
+donated-buffer discipline, bounded program counts, the ONE serve-knob
+mapping, metric-name consistency, lock discipline in the threaded
+serving/comm tiers, in-trace purity — run as an stdlib-`ast` analyzer
+over the package tree. FedJAX (arXiv:2108.02117) and FL_PyTorch
+(arXiv:2202.03099) both argue simulation frameworks live or die by
+machine-checkable contracts between their layers; this module is ours.
+
+Entry points:
+  - `python -m fedml_tpu lint [--format text|json] [--rules a,b] [paths]`
+  - `fedml_tpu.analysis.run_lint(...)` (the tier-1 zero-findings gate and
+    the `lint_clean` diagnosis probe call this in-process)
+
+Suppression: append `# graftlint: disable=<rule>[,<rule>...]` to the
+flagged line. Every suppression should carry a justification in the
+surrounding comment — the linter does not verify prose, reviewers do.
+
+The package is deliberately stdlib-only (ast + re + json): the Docker
+build hook and external CI can run it before any jax wheel exists.
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Finding,
+    LintContext,
+    all_rules,
+    render_json,
+    render_text,
+    run_lint,
+)
+
+__all__ = ["Finding", "LintContext", "all_rules", "run_lint",
+           "render_text", "render_json"]

@@ -154,7 +154,11 @@ class DPArgs:
 @dataclass
 class ServeArgs:
     """Model-serving knobs (serving/). All engine knobs ride `extra` so
-    reference YAMLs (which have no serving section) load unchanged:
+    reference YAMLs (which have no serving section) load unchanged.
+    The authoritative key set, kinds/bounds, and gating live in
+    serving/knobs.py (KNOBS) — validation iterates that registry, and
+    graftlint's knob-drift rule cross-checks it against the predictor
+    and fleet mappings, so this docstring is prose, not a key list:
       decode_slots      — >0 starts the continuous-batching DecodeEngine
                           (serving/engine.py) with that many slots
       engine_max_len    — per-slot KV capacity (prompt + max_new <= this)
@@ -467,152 +471,18 @@ class Config:
                 raise ValueError(
                     "common_args.extra.metrics_port must be an integer in "
                     f"[0, 65535] (0 = ephemeral); got {mp!r}")
-        # continuous-batching serving knobs (serving/engine.py), validated
+        # serving knobs (serving/engine.py and the fleet tier), validated
         # at load so a typo'd YAML fails before a replica silently comes up
         # in per-request mode (decode_slots=0 IS the per-request path).
-        # serve_args is fully owned by this framework (no reference-YAML
-        # grab-bag to stay compatible with), so UNKNOWN keys are rejected
-        # too — a misspelled decode_slots must not pass silently.
-        _serve_knobs = {"decode_slots", "engine_max_len",
-                        "engine_fetch_chunk", "engine_eos_id",
-                        "sampler_cache_size", "kv_cache", "engine_mp",
-                        "kv_page_size", "kv_n_pages", "prefill_chunk",
-                        "prefix_cache", "paged_kernel", "spec_decode",
-                        "spec_k", "drain_timeout_s", "shed_watermark",
-                        "retry_after_s", "probation_deadline_s",
-                        "probe_backoff_s"}
-        unknown = set(self.serve_args.extra) - _serve_knobs
-        if unknown:
-            raise ValueError(
-                f"unknown serve_args knob(s) {sorted(unknown)}; valid: "
-                f"{sorted(_serve_knobs)}")
-        kvc = self.serve_args.extra.get("kv_cache")
-        if kvc is not None and not isinstance(kvc, bool):
-            raise ValueError(
-                f"serve_args.kv_cache must be a boolean; got {kvc!r}")
-        pfx = self.serve_args.extra.get("prefix_cache")
-        if pfx is not None and not isinstance(pfx, bool):
-            raise ValueError(
-                f"serve_args.prefix_cache must be a boolean; got {pfx!r}")
-        for knob, lo in (("decode_slots", 0), ("engine_max_len", 1),
-                         ("engine_fetch_chunk", 1), ("engine_eos_id", 0),
-                         ("sampler_cache_size", 1), ("engine_mp", 1),
-                         ("kv_page_size", 1), ("kv_n_pages", 2),
-                         ("prefill_chunk", 0)):
-            val = self.serve_args.extra.get(knob)
-            if val is None:
-                continue
-            try:
-                ok = (not isinstance(val, bool)
-                      and int(val) == float(val) and int(val) >= lo)
-            except (TypeError, ValueError):
-                ok = False
-            if not ok:
-                raise ValueError(
-                    f"serve_args.{knob} must be an integer >= {lo}; "
-                    f"got {val!r}")
-        # fleet knobs (ISSUE 9) are durations/ratios — positive numbers
-        # (drain_timeout_s/shed_watermark may be 0 = disabled)
-        for knob, strict in (("drain_timeout_s", False),
-                             ("shed_watermark", False),
-                             ("retry_after_s", True),
-                             ("probation_deadline_s", True),
-                             ("probe_backoff_s", True)):
-            val = self.serve_args.extra.get(knob)
-            if val is None:
-                continue
-            try:
-                ok = (not isinstance(val, bool)
-                      and (float(val) > 0 if strict else float(val) >= 0))
-            except (TypeError, ValueError):
-                ok = False
-            if not ok:
-                raise ValueError(
-                    f"serve_args.{knob} must be a "
-                    f"{'positive' if strict else 'non-negative'} number; "
-                    f"got {val!r}")
-        # engine_mp only takes effect inside the engine (decode_slots > 0):
-        # a config asking for tensor-parallel serving without the engine
-        # would silently run single-chip per-request — refuse at load
-        # instead (the other engine_* knobs double as per-request knobs,
-        # e.g. engine_max_len sizes both paths, so only this one is gated)
-        mp_knob = self.serve_args.extra.get("engine_mp")
-        if mp_knob is not None and int(mp_knob) > 1 \
-                and not self.serve_args.extra.get("decode_slots"):
-            raise ValueError(
-                "serve_args.engine_mp > 1 requires decode_slots > 0 — "
-                "tensor-parallel serving runs inside the decode engine; "
-                "without slots the knob would be silently ignored")
-        # paged-cache knobs (serving/engine.py page_size > 0) are gated
-        # the same way: each only takes effect inside the paged engine,
-        # so a config naming one without its prerequisite would silently
-        # serve contiguous/per-request — refuse at load instead
-        if self.serve_args.extra.get("kv_page_size") \
-                and not self.serve_args.extra.get("decode_slots"):
-            raise ValueError(
-                "serve_args.kv_page_size requires decode_slots > 0 — the "
-                "paged KV cache lives inside the decode engine; without "
-                "slots the knob would be silently ignored")
-        for knob in ("kv_n_pages", "prefill_chunk", "prefix_cache"):
-            if self.serve_args.extra.get(knob) is not None \
-                    and not self.serve_args.extra.get("kv_page_size"):
-                raise ValueError(
-                    f"serve_args.{knob} requires kv_page_size > 0 (the "
-                    "paged KV cache) — without paging the knob would be "
-                    "silently ignored")
-        # decode-speed knobs (ISSUE 11): the Pallas paged-attention
-        # kernel and n-gram speculative decoding both live inside the
-        # PAGED engine — same gating discipline, a knob that would be
-        # silently ignored is refused at load
-        pk = self.serve_args.extra.get("paged_kernel")
-        if pk is not None and not isinstance(pk, bool):
-            raise ValueError(
-                f"serve_args.paged_kernel must be a boolean; got {pk!r}")
-        if pk and not self.serve_args.extra.get("kv_page_size"):
-            raise ValueError(
-                "serve_args.paged_kernel requires kv_page_size > 0 — the "
-                "fused kernel reads the paged KV pool in place; without "
-                "paging the knob would be silently ignored")
-        sd = self.serve_args.extra.get("spec_decode")
-        if sd is not None:
-            # YAML 1.1 reads an unquoted `off` as boolean False — that IS
-            # the documented disable spelling, so normalize it instead of
-            # rejecting the user's own docs back at them (True has no
-            # mode to normalize to: name the quoting problem)
-            if sd is False:
-                sd = self.serve_args.extra["spec_decode"] = "off"
-            if sd is True:
-                raise ValueError(
-                    "serve_args.spec_decode: true is not a mode — use "
-                    "'ngram' (YAML parses unquoted off/on as booleans; "
-                    "quote the value)")
-            if sd not in ("off", "ngram"):
-                raise ValueError(
-                    "serve_args.spec_decode must be 'off' or 'ngram'; "
-                    f"got {sd!r}")
-            if sd != "off" and not self.serve_args.extra.get(
-                    "kv_page_size"):
-                raise ValueError(
-                    "serve_args.spec_decode requires kv_page_size > 0 — "
-                    "speculative verify-and-rollback rides the paged KV "
-                    "cache's page table; without paging the knob would "
-                    "be silently ignored")
-        sk = self.serve_args.extra.get("spec_k")
-        if sk is not None:
-            try:
-                ok = (not isinstance(sk, bool)
-                      and int(sk) == float(sk) and int(sk) >= 1)
-            except (TypeError, ValueError):
-                ok = False
-            if not ok:
-                raise ValueError(
-                    f"serve_args.spec_k must be an integer >= 1; got "
-                    f"{sk!r}")
-            if sd in (None, "off"):
-                raise ValueError(
-                    "serve_args.spec_k requires spec_decode: ngram — "
-                    "the draft length only exists under speculation; "
-                    "without it the knob would be silently ignored")
+        # The key set, kinds, and gating all live in serving/knobs.py —
+        # THE serve-knob registry the predictor/fleet mappings and
+        # graftlint's knob-drift rule also read, so the validated set and
+        # the consumed set physically cannot drift (ISSUE 13). The import
+        # is jax-free: serving/__init__ is lazy and knobs.py is a literal
+        # table.
+        from .serving.knobs import validate_serve_args
+
+        validate_serve_args(self.serve_args.extra)
         # partitioning-plane knobs (parallel/partition.py): the rule-table
         # name must exist in the registry and the unmatched policy must be
         # a known one — a typo'd table fails at load, not as an

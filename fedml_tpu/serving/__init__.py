@@ -14,12 +14,8 @@ Simulator trained (or a checkpoint directory it saved).
 """
 from __future__ import annotations
 
+import importlib
 from typing import Callable
-
-from .engine import DecodeEngine, Ticket
-from .export import export_model, load_export, predictor_from_export
-from .inference_runner import DEFAULT_PORT, FedMLInferenceRunner
-from .predictor import GreedyLMPredictor, JaxPredictor, Predictor
 
 __all__ = [
     "Predictor", "JaxPredictor", "GreedyLMPredictor",
@@ -28,6 +24,29 @@ __all__ = [
     "predictor_from_checkpoint", "predictor_from_artifact",
     "export_model", "load_export", "predictor_from_export",
 ]
+
+# Lazy re-exports (PEP 562, same pattern as the package root): the heavy
+# submodules import jax, but `fedml_tpu.serving.knobs` — the serve-knob
+# registry config.py validates against at load time — must be importable
+# without dragging a backend in. Importing THIS package therefore stays
+# jax-free; the first access to an engine/predictor symbol pays the
+# submodule import.
+_LAZY = {
+    "DecodeEngine": "engine", "Ticket": "engine",
+    "export_model": "export", "load_export": "export",
+    "predictor_from_export": "export",
+    "DEFAULT_PORT": "inference_runner",
+    "FedMLInferenceRunner": "inference_runner",
+    "GreedyLMPredictor": "predictor", "JaxPredictor": "predictor",
+    "Predictor": "predictor",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
 
 
 def lm_predictor_from_config(cfg, model, params, adapters=None,
@@ -57,6 +76,7 @@ def predictor_from_artifact(store, round_idx: int,
     __init__.py:388). `store` is a utils/artifacts.py store (or anything
     with .get(name))."""
     from ..utils.artifacts import aggregated_name
+    from .predictor import JaxPredictor
 
     return JaxPredictor(apply_fn, store.get(aggregated_name(round_idx)))
 
@@ -67,6 +87,7 @@ def predictor_from_checkpoint(ckpt_dir: str, apply_fn: Callable,
     predictor (reference analog: fedml_server.py serving the aggregated
     model; here the source of truth is utils/checkpoint.py state)."""
     from ..utils.checkpoint import restore_checkpoint
+    from .predictor import JaxPredictor
 
     _r, server, _c, _h, _hist = restore_checkpoint(ckpt_dir, server_template)
     return JaxPredictor(apply_fn, server.params)
@@ -79,6 +100,9 @@ def serve_simulator(sim, host: str = "127.0.0.1", port: int = 0,
     reference would break if training continues after this call."""
     import jax
     import jax.numpy as jnp
+
+    from .inference_runner import FedMLInferenceRunner
+    from .predictor import JaxPredictor
 
     pred = JaxPredictor(
         sim.apply_fn, jax.tree.map(jnp.array, sim.server_state.params))

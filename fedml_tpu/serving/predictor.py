@@ -114,14 +114,14 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
                                   adapters=None, detokenize=None,
                                   default_max_len: int = 256
                                   ) -> "GreedyLMPredictor":
-    """THE serve-knob -> GreedyLMPredictor mapping (decode_slots,
-    engine_max_len, engine_eos_id, engine_fetch_chunk, sampler_cache_size,
-    kv_cache, engine_mp, kv_page_size, kv_n_pages, prefill_chunk,
-    prefix_cache, paged_kernel, spec_decode, spec_k, drain_timeout_s),
-    shared by the config route
-    (serving.lm_predictor_from_config reads Config.serve_args.extra) and
-    the deploy route (scheduler.start_replica reads the spec's serve
-    dict) — one mapping, so the two surfaces cannot drift."""
+    """THE serve-knob -> GreedyLMPredictor mapping for every knob
+    serving/knobs.py tags `consumer: predictor` (the registry is the one
+    authoritative key list; graftlint's knob-drift rule fails the build
+    if this function and the registry disagree). Shared by the config
+    route (serving.lm_predictor_from_config reads
+    Config.serve_args.extra) and the deploy route
+    (scheduler.start_replica reads the spec's serve dict) — one mapping,
+    so the two surfaces cannot drift."""
     eos = sv.get("engine_eos_id")
     n_pages = sv.get("kv_n_pages")
     return GreedyLMPredictor(

@@ -387,7 +387,13 @@ class Deployment:
         deadline = time.monotonic() + self.probation_deadline_s
         backoff = self.probe_backoff_s
         while time.monotonic() < deadline:
-            target = self._adapter_target
+            # read the current update target under the lock: this probe
+            # thread races rolling_update's write, and the lock (not GIL
+            # reference atomicity) is what makes the later
+            # `is not target` re-check under the same lock coherent
+            # (graftlint lock-discipline, ISSUE 13)
+            with self._lock:
+                target = self._adapter_target
             if self._probe_ready(rep) and self._converge_version(rep, target):
                 with self._lock:
                     if rep.state != R_SUSPECT:   # scale_down won the race

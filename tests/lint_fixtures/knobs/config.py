@@ -1,0 +1,11 @@
+"""graftlint fixture: the PRE-ISSUE-13 config shape — a hand-synced
+serve-knob key list instead of the serving/knobs.py registry (this is
+the literal defect shape graftlint flagged on the pre-refactor tree)."""
+
+_serve_knobs = {"alpha", "beta", "gamma"}
+
+
+def validate(extra):
+    unknown = set(extra) - _serve_knobs
+    if unknown:
+        raise ValueError(f"unknown serve_args knob(s) {sorted(unknown)}")

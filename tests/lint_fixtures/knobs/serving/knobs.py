@@ -1,0 +1,7 @@
+"""graftlint fixture: knob-drift anchor registry (staged mini-tree)."""
+
+KNOBS = {
+    "alpha": {"kind": "int", "min": 0, "consumer": "predictor"},
+    "beta": {"kind": "bool", "consumer": "predictor"},
+    "gamma": {"kind": "num", "strict": True, "consumer": "fleet"},
+}

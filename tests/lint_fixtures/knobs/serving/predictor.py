@@ -1,0 +1,10 @@
+"""graftlint fixture: predictor mapping that DRIFTED from the registry —
+`beta` is validated but never mapped (the PR 5/9/11 bug shape), and
+`delta` is read but never registered (a dead read)."""
+
+
+def lm_predictor_from_serve_knobs(sv, model, params):
+    return {
+        "alpha": int(sv.get("alpha", 0)),
+        "delta": sv.get("delta"),
+    }

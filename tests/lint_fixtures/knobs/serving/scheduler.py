@@ -1,0 +1,11 @@
+"""graftlint fixture: fleet mapping is honest, but start_replica builds
+its predictor OFF the shared mapping — the deploy-surface drift."""
+
+
+def fleet_knobs(sv):
+    return {"gamma": float(sv.get("gamma", 1.0))}
+
+
+def start_replica(spec):
+    sv = dict(spec.get("serve", {}))
+    return {"alpha": sv.get("alpha")}    # side-channel, not the mapping

@@ -1,0 +1,7 @@
+"""graftlint fixture: config consuming the registry's validator — the
+post-ISSUE-13 shape."""
+from .serving.knobs import validate_serve_args
+
+
+def validate(extra):
+    validate_serve_args(extra)

@@ -1,0 +1,8 @@
+"""graftlint fixture: a predictor mapping in lockstep with the registry."""
+
+
+def lm_predictor_from_serve_knobs(sv, model, params):
+    return {
+        "alpha": int(sv.get("alpha", 0)),
+        "beta": bool(sv.get("beta", False)),
+    }

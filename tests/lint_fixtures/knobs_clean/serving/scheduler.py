@@ -1,0 +1,12 @@
+"""graftlint fixture: fleet mapping + deploy surface riding the shared
+mapping — the shape the real tree keeps."""
+from .predictor import lm_predictor_from_serve_knobs
+
+
+def fleet_knobs(sv):
+    return {"gamma": float(sv.get("gamma", 1.0))}
+
+
+def start_replica(spec):
+    return lm_predictor_from_serve_knobs(
+        dict(spec.get("serve", {})), None, None)

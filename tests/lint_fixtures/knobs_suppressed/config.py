@@ -1,0 +1,8 @@
+# fixture: legacy hand-synced shape, kept deliberately  # graftlint: disable=knob-drift
+_serve_knobs = {"alpha", "beta", "gamma"}  # graftlint: disable=knob-drift (fixture: suppression contract)
+
+
+def validate(extra):
+    unknown = set(extra) - _serve_knobs
+    if unknown:
+        raise ValueError(f"unknown serve_args knob(s) {sorted(unknown)}")

@@ -1,0 +1,8 @@
+"""graftlint fixture: same drift as ../knobs, every finding suppressed."""
+
+
+def lm_predictor_from_serve_knobs(sv, model, params):  # graftlint: disable=knob-drift (fixture: suppression contract)
+    return {
+        "alpha": int(sv.get("alpha", 0)),
+        "delta": sv.get("delta"),
+    }

@@ -1,0 +1,10 @@
+"""graftlint fixture: same drift as ../knobs, every finding suppressed."""
+
+
+def fleet_knobs(sv):
+    return {"gamma": float(sv.get("gamma", 1.0))}
+
+
+def start_replica(spec):  # graftlint: disable=knob-drift (fixture: suppression contract)
+    sv = dict(spec.get("serve", {}))
+    return {"alpha": sv.get("alpha")}

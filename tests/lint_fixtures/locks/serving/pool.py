@@ -1,0 +1,44 @@
+"""graftlint fixture: lock-discipline (positive + negative + suppressed).
+Lives under a `serving/` dir because the rule only patrols the threaded
+serving/comm tiers. Never imported — parsed by the linter only."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0              # __init__ is exempt (pre-thread)
+        self.items = []
+
+    def put(self, x):
+        with self._lock:
+            self.items = self.items + [x]
+            self.depth += 1
+
+    def bad_read(self):
+        return self.depth           # FINDING: bare read, other method
+
+    def bad_write(self):
+        self.depth = 0              # FINDING: bare write, other method
+
+    def ok_read(self):
+        with self._lock:
+            return self.depth
+
+    def mixed_same_method(self):
+        with self._lock:
+            self.depth += 1
+        return self.depth           # same method as a guarded write: exempt
+
+    def silenced(self):
+        return self.depth  # graftlint: disable=lock-discipline (fixture: snapshot read, staleness acceptable)
+
+
+class NoLocks:
+    """No lock discipline declared — nothing to enforce."""
+
+    def __init__(self):
+        self.depth = 0
+
+    def bump(self):
+        self.depth += 1             # clean: class never locks

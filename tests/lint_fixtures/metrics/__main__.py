@@ -1,0 +1,20 @@
+"""graftlint fixture: metric-registry consumer surfaces (a miniature
+`top`). Never imported — parsed by the linter only."""
+
+
+def _top_frame(snap):
+    c, g = snap["counters"], snap["gauges"]
+    rounds = c.get("fed_rounds_total", 0)
+    depth = g.get("serving_queue_depth", 0)
+    ghost = g.get("serving_kv_pages_free", 0)     # FINDING: never emitted
+    quiet = c.get("fed_ghost_total", 0)  # graftlint: disable=metric-registry (fixture: suppression contract)
+    part = {k: v for k, v in c.items()
+            if k.startswith("fed_participation_c")}
+    return rounds, depth, ghost, quiet, part
+
+
+def probe(snap):
+    # raw dotted snapshot reads (the diagnosis-probe surface)
+    ok = snap["counters"].get("fed.rounds_total", 0)
+    missing = snap["counters"].get("serving.prefix_hits", 0)  # graftlint: disable=metric-registry (fixture: suppression contract)
+    return ok, missing

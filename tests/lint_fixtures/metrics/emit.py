@@ -1,0 +1,36 @@
+"""graftlint fixture: metric-registry emit sites (typo positive +
+suppressed + clean). Never imported — parsed by the linter only."""
+from utils import metrics as mx
+
+
+def round_done():
+    mx.inc("fed.rounds_total")
+
+
+def block_done():
+    mx.inc("fed.rounds_total")           # 2nd site: established name
+
+
+def typo_site():
+    mx.inc("fed.round_total")            # FINDING: 1 edit from established
+
+
+def queue(depth):
+    mx.set_gauge("serving.queue_depth", depth)
+
+
+def typo_gauge(depth):
+    mx.set_gauge("serving.queue_dept", depth)     # FINDING: consumed name
+
+
+def typo_suppressed(depth):
+    mx.set_gauge("serving.queue_depti", depth)  # graftlint: disable=metric-registry (fixture: suppression contract)
+
+
+def per_client(cid):
+    mx.inc(f"fed.participation.c{cid}")  # prefix emit
+
+
+def span_only(recorder):
+    with recorder.span("serving.swap.fixture"):
+        pass

@@ -1,0 +1,46 @@
+"""graftlint fixture: donation-after-use (positive + negative +
+suppressed-negative). Never imported — parsed by the linter only."""
+import jax
+
+
+def bad(body, carry):
+    step = jax.jit(body, donate_argnums=(0,))
+    out = step(carry)
+    return out + carry["x"]          # FINDING: carry read after donation
+
+
+def ok_rebind(body, carry):
+    step = jax.jit(body, donate_argnums=(0,))
+    carry = step(carry)
+    return carry["x"]                # rebind at the call site — clean
+
+
+def ok_not_donated(body, carry):
+    step = jax.jit(body)
+    out = step(carry)
+    return out + carry["x"]          # no donate_argnums — clean
+
+
+def bad_tracked(body, carry):
+    step = track_jit(jax.jit(body, donate_argnums=(0,)), "fixture")
+    out = step(carry)
+    return out + carry["x"]          # FINDING: donation through track_jit
+
+
+def silenced(body, carry):
+    step = jax.jit(body, donate_argnums=(0,))
+    out = step(carry)
+    return out + carry["x"]  # graftlint: disable=donation-after-use (fixture: justified read)
+
+
+class Engine:
+    def __init__(self, body):
+        self._step = jax.jit(body, donate_argnums=(1,))
+
+    def bad_method(self, params, carry):
+        out = self._step(params, self._carry)
+        return out + self._carry["kv"]   # FINDING: self attr after donation
+
+    def ok_method(self, params):
+        self._carry = self._step(params, self._carry)
+        return self._carry["kv"]

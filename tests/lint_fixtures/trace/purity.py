@@ -1,0 +1,50 @@
+"""graftlint fixture: in-trace-purity (positive, transitive, negative,
+suppressed). Never imported — parsed by the linter only."""
+import time
+
+import jax
+import numpy as np
+
+
+def _noise(x):
+    return x * np.random.rand()      # FINDING: reached from traced root
+
+
+def traced_step(x):
+    t = time.time()                  # FINDING: clock read at trace time
+    return _noise(x) + t
+
+
+def build():
+    return jax.jit(traced_step)
+
+
+def scan_body(carry, x):
+    np.random.seed(0)                # FINDING: scanned body
+    return carry, x
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0, xs)
+
+
+def host_only(x):
+    return time.time()               # never traced — clean
+
+
+def ok_local_rng(x):
+    rs = np.random.RandomState(0)    # constructor, local state — clean
+    return x + rs.rand()
+
+
+def build_ok():
+    return jax.jit(ok_local_rng)
+
+
+def silenced_step(x):
+    t = time.perf_counter()  # graftlint: disable=in-trace-purity (fixture: justified)
+    return x + t
+
+
+def build_silenced():
+    return jax.jit(silenced_step)
