@@ -451,6 +451,120 @@ def bench_reliable_comm() -> dict:
     }
 
 
+def bench_comm_codec(quick: bool = False) -> dict:
+    """Wire codec rows (ISSUE 14): the digits cross-silo workload over
+    loopback, dense vs the sparse delta codec (comm/codec.py sparse_topk,
+    keep-5% + error feedback) on IDENTICAL partitions and seeds.
+
+    - comm_codec_payload_reduction_x: sender-side bytes_raw/bytes_wire over
+      the codec-handled uplink payloads (bar >= 8x; uint16 idx + float32
+      val at keep-8% is 8.3x over dense float32);
+    - comm_codec_digits_acc vs _dense: final test accuracy with/without the
+      codec (bar: < 1pt loss — error feedback carries what top-k drops
+      into the next round's delta);
+    - comm_codec_encode_ms_p50 / _decode_ms_p50: codec latency.
+    Control-frame byte-identity and the secagg bitwise pin live in
+    tests/test_wire_codec.py; this row is the accuracy-vs-bytes evidence.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.comm import FedCommManager, create_transport
+    from fedml_tpu.comm.loopback import release_router
+    from fedml_tpu.config import TrainArgs
+    from fedml_tpu.cross_silo import (
+        FedClientManager, FedServerManager, SiloTrainer,
+    )
+    from fedml_tpu.data import loader as data_loader
+    from fedml_tpu.models import hub
+    from fedml_tpu.parity import PARITY_HP
+    from fedml_tpu.utils import metrics as mx
+
+    rounds = 10 if quick else PARITY_HP["comm_round"]
+    cfg = fedml_tpu.init(config=_digits_config())
+    ds = data_loader.load(cfg)
+    n_clients = ds.num_clients
+    model = hub.create("mlp", ds.num_classes)
+    params_np = jax.tree.map(np.asarray, hub.init_params(
+        model, ds.x_train.shape[2:], jax.random.key(0)))
+    t = TrainArgs(
+        epochs=PARITY_HP["epochs"], batch_size=PARITY_HP["batch_size"],
+        learning_rate=PARITY_HP["learning_rate"],
+        client_num_in_total=n_clients, client_num_per_round=n_clients,
+        comm_round=rounds)
+    shards = []
+    for i in range(n_clients):
+        keep = ds.mask_train[i] > 0
+        shards.append((ds.x_train[i][keep], ds.y_train[i][keep]))
+
+    def final_acc(params) -> float:
+        pj = jax.tree.map(jnp.asarray, params)
+        logits = model.apply({"params": pj}, jnp.asarray(ds.x_test))
+        return float((jnp.argmax(logits, -1)
+                      == jnp.asarray(ds.y_test)).mean())
+
+    def one_run(tag, codec):
+        run_id = f"bench-codec-{tag}"
+        mk = lambda r: FedCommManager(  # noqa: E731
+            create_transport("loopback", r, run_id, comm_codec=codec), r)
+        server = FedServerManager(
+            mk(0), client_ids=list(range(1, n_clients + 1)),
+            init_params=params_np, num_rounds=rounds)
+        clients = [
+            FedClientManager(mk(cid), cid,
+                             SiloTrainer(model.apply, t, *shards[cid - 1],
+                                         seed=cid))
+            for cid in range(1, n_clients + 1)]
+        server.run(background=True)
+        for c in clients:
+            c.run(background=True)
+            c.announce_ready()
+        ok = server.done.wait(timeout=900)
+        for c in clients:
+            c.done.wait(timeout=30)
+        release_router(run_id)
+        if not ok:
+            raise TimeoutError(f"comm-codec bench {tag!r} did not finish")
+        return final_acc(server.params)
+
+    # keep-12% at fp16 values: uint16 idx + float16 val = 4 bytes per kept
+    # element, so 0.12 clears the 8x bar (4 / (0.12 * 4) = 8.3x) while
+    # keeping enough per-round mass for <1pt final accuracy — the fp16
+    # rounding error rides the EF residual, so it is compensated, not lost
+    codec_cfg = {"kind": "sparse_topk", "ratio": 0.12, "val_bits": 16,
+                 "error_feedback": True}
+    acc_dense = one_run("dense", None)
+    snap0 = mx.snapshot()
+    acc_codec = one_run("sparse", codec_cfg)
+    snap1 = mx.snapshot()
+    raw = (snap1["counters"].get("comm.codec.loopback.bytes_raw", 0)
+           - snap0["counters"].get("comm.codec.loopback.bytes_raw", 0))
+    wire = (snap1["counters"].get("comm.codec.loopback.bytes_wire", 0)
+            - snap0["counters"].get("comm.codec.loopback.bytes_wire", 0))
+    out = {
+        "comm_codec_payload_reduction_x": round(raw / wire, 2) if wire
+        else None,
+        "comm_codec_reduction_bar_x": 8.0,
+        "comm_codec_digits_acc": round(acc_codec, 4),
+        "comm_codec_digits_acc_dense": round(acc_dense, 4),
+        "comm_codec_digits_acc_delta_pt": round(
+            (acc_dense - acc_codec) * 100, 2),
+        "comm_codec_acc_bar_pt": 1.0,
+        "comm_codec_bytes_raw": raw,
+        "comm_codec_bytes_wire": wire,
+        "comm_codec_rounds": rounds,
+    }
+    for leg, label in (("encode_s", "comm_codec_encode_ms_p50"),
+                       ("decode_s", "comm_codec_decode_ms_p50")):
+        p = mx.percentile_from_snapshots(
+            snap0, snap1, f"comm.codec.loopback.{leg}", 0.5)
+        if p is not None:
+            out[label] = round(p * 1e3, 3)
+    return out
+
+
 def bench_cross_silo_durability(quick: bool = False) -> dict:
     """Cross-silo durability rows (ISSUE 10).
 
@@ -1837,6 +1951,10 @@ _HEADLINE_KEYS = (
     "w1_health_overhead_pct",
     # chaos plane + reliable delivery (ISSUE 4): protocol-overhead row
     "w1_reliable_comm_overhead_pct",
+    # wire codec plane (ISSUE 14): uplink payload reduction at accuracy
+    # parity on the digits cross-silo workload
+    "comm_codec_payload_reduction_x", "comm_codec_digits_acc_delta_pt",
+    "comm_codec_digits_acc",
     # continuous-batching serving (ISSUE 5): concurrency-8 decode row
     "serving_cb_speedup_vs_per_request", "serving_cb_tokens_per_sec",
     "serving_cb_ttft_p50_ms",
@@ -1922,6 +2040,8 @@ def main():
                {"w1_error": "bench_workload1 failed twice"})
     acc.update(_retrying(bench_reliable_comm, default=None) or
                {"w1_reliable_comm_error": "bench_reliable_comm failed twice"})
+    acc.update(_retrying(bench_comm_codec, quick, default=None) or
+               {"comm_codec_error": "bench_comm_codec failed twice"})
     acc.update(_retrying(bench_serving_cb, quick, default=None) or
                {"serving_cb_error": "bench_serving_cb failed twice"})
     acc.update(_retrying(bench_serving_paged, quick, default=None) or

@@ -19,6 +19,13 @@ knob's consumer surface; this rule asserts
 
 The rule activates only when all four anchor files are in the scan, so
 subset scans and fixture trees stage exactly what they mean to test.
+
+The same discipline covers the wire-codec plane (ISSUE 14): comm/codec.py's
+`CODEC_KNOBS` registry (pure literal, consumer="policy") is cross-checked
+against `make_policy` — every registered comm_codec knob must be read there,
+nothing unregistered may be — and config.py must validate comm_codec through
+`validate_comm_codec` instead of a hand-rolled key list. This leg anchors on
+comm/codec.py + config.py and stays dormant in scans that stage neither.
 """
 from __future__ import annotations
 
@@ -71,6 +78,10 @@ class KnobDriftRule(Rule):
                "cross-check")
 
     def check(self, ctx: LintContext) -> Iterable[Finding]:
+        yield from self._serve_leg(ctx)
+        yield from self._codec_leg(ctx)
+
+    def _serve_leg(self, ctx: LintContext) -> Iterable[Finding]:
         anchors = {a: ctx.get(a) for a in _ANCHORS}
         if any(v is None for v in anchors.values()):
             return  # subset scan: nothing to cross-check against
@@ -89,6 +100,77 @@ class KnobDriftRule(Rule):
              if s.get("consumer") == "fleet"}, registry, "fleet")
         yield from self._check_start_replica(anchors["serving/scheduler.py"])
         yield from self._check_config(anchors["config.py"], registry)
+
+    # ------------------------------------------------------- codec leg
+    def _codec_leg(self, ctx: LintContext) -> Iterable[Finding]:
+        codec_f = ctx.get("comm/codec.py")
+        config_f = ctx.get("config.py")
+        if codec_f is None or config_f is None:
+            return  # subset scan: codec plane not staged
+        registry = self._load_codec_registry(codec_f)
+        if isinstance(registry, Finding):
+            yield registry
+            return
+        yield from self._check_mapping(
+            codec_f, "make_policy", set(registry), registry, "policy",
+            registry_label="comm/codec.py CODEC_KNOBS")
+        # config.py must validate comm_codec THROUGH the codec module
+        imports_codec = any(
+            isinstance(n, ast.ImportFrom) and n.module
+            and n.module.split(".")[-2:] == ["comm", "codec"]
+            for n in ast.walk(config_f.tree))
+        calls_validator = any(
+            isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name)
+                 and n.func.id == "validate_comm_codec")
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "validate_comm_codec"))
+            for n in ast.walk(config_f.tree))
+        if not (imports_codec and calls_validator):
+            yield Finding(
+                self.name, config_f.path, 1, 0,
+                "config.py does not validate comm_codec through "
+                "comm/codec.py (`from .comm.codec import "
+                "validate_comm_codec`) — the validated key set can drift "
+                "from the policy consumer")
+        for node in ast.walk(config_f.tree):
+            if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+                strs = {const_str(e) for e in node.elts} - {None}
+                hits = strs & set(registry)
+                if len(hits) >= 3:
+                    yield Finding(
+                        self.name, config_f.path, node.lineno,
+                        node.col_offset,
+                        f"literal key list holding {len(hits)} comm_codec "
+                        "registry knobs — a hand-synced copy of "
+                        "comm/codec.py CODEC_KNOBS that WILL drift; "
+                        "iterate the registry instead")
+
+    def _load_codec_registry(self, f: SourceFile):
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "CODEC_KNOBS"
+                    for t in node.targets):
+                try:
+                    reg = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return Finding(
+                        self.name, f.path, node.lineno, node.col_offset,
+                        "CODEC_KNOBS must stay a pure literal — graftlint "
+                        "(and the import-free Docker build hook) reads it "
+                        "with ast.literal_eval")
+                bad = [k for k, s in reg.items()
+                       if not isinstance(s, dict)
+                       or s.get("consumer") != "policy"]
+                if bad:
+                    return Finding(
+                        self.name, f.path, node.lineno, node.col_offset,
+                        f"codec registry entries {sorted(bad)} missing the "
+                        "'policy' consumer tag — the drift check cannot "
+                        "assign them a mapping")
+                return reg
+        return Finding(self.name, f.path, 1, 0,
+                       "comm/codec.py defines no CODEC_KNOBS registry")
 
     # ------------------------------------------------------------------
     def _load_registry(self, f: SourceFile):
@@ -118,7 +200,9 @@ class KnobDriftRule(Rule):
                        "serving/knobs.py defines no KNOBS registry")
 
     def _check_mapping(self, f: SourceFile, fn_name: str, owned: set[str],
-                       registry: dict, surface: str) -> Iterable[Finding]:
+                       registry: dict, surface: str,
+                       registry_label: str = "serving/knobs.py"
+                       ) -> Iterable[Finding]:
         fn = _find_def(f.tree, fn_name)
         if fn is None:
             yield Finding(
@@ -131,14 +215,14 @@ class KnobDriftRule(Rule):
         for k in sorted(owned - consumed):
             yield Finding(
                 self.name, f.path, fn.lineno, fn.col_offset,
-                f"knob `{k}` is validated at config load (serving/knobs.py "
+                f"knob `{k}` is validated at config load ({registry_label} "
                 f"tags it consumer={surface!r}) but `{fn_name}` never reads "
                 "it — validated-then-dropped, the exact drift the registry "
                 "exists to prevent")
         for k in sorted(consumed - set(registry)):
             yield Finding(
                 self.name, f.path, fn.lineno, fn.col_offset,
-                f"`{fn_name}` reads knob `{k}` that serving/knobs.py does "
+                f"`{fn_name}` reads knob `{k}` that {registry_label} does "
                 "not register — config validation would reject any YAML "
                 "naming it, so the read is dead (or the registry is "
                 "missing an entry)")

@@ -9,6 +9,7 @@ does not exist here at all — it's XLA collectives inside the round program
 """
 from .base import BaseTransport, Observer
 from .chaos import ChaosTransport, FaultSpec
+from .codec import CodecPolicy, validate_comm_codec
 from .loopback import LoopbackTransport, get_router
 from .manager import FedCommManager, create_transport
 from .message import Message
@@ -21,5 +22,5 @@ __all__ = [
     "create_transport", "LoopbackTransport", "get_router", "encode", "decode",
     "SymmetricTopologyManager", "AsymmetricTopologyManager",
     "ChaosTransport", "FaultSpec", "ReliableTransport", "RetryPolicy",
-    "DeliveryError",
+    "DeliveryError", "CodecPolicy", "validate_comm_codec",
 ]

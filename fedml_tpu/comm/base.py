@@ -40,6 +40,16 @@ class BaseTransport(abc.ABC):
 
     def __init__(self):
         self._observers: list[Observer] = []
+        #: wire codec plane (ISSUE 14): when set, `_encode_frame` compresses
+        #: training payloads per message type and `_decode_frame` reverses
+        #: them off the frame's own codec header. Attach to the INNERMOST
+        #: transport (create_transport does this before the chaos/reliable
+        #: wrappers) so injected faults and retransmits see compressed frames.
+        self._codec = None
+
+    def set_codec(self, policy) -> None:
+        """Attach a comm.codec.CodecPolicy (or None to disable)."""
+        self._codec = policy
 
     def add_observer(self, obs: Observer) -> None:
         self._observers.append(obs)
@@ -85,6 +95,10 @@ class BaseTransport(abc.ABC):
         there instead)."""
         if stamp:
             msg.stamp_trace()
+        if self._codec is not None:
+            # idempotent per message object: a retransmit re-entering here
+            # sees the codec header marker and passes through unchanged
+            self._codec.encode_message(msg, self.backend_name)
         t0 = time.perf_counter()
         frame = msg.encode()
         pre = f"comm.{self.backend_name}"
@@ -96,6 +110,12 @@ class BaseTransport(abc.ABC):
     def _decode_frame(self, frame: bytes) -> Message:
         t0 = time.perf_counter()
         msg = Message.decode(frame)
+        # codec headers are self-describing, so this runs regardless of the
+        # local policy; a mismatched/unknown codec raises out of here and
+        # `_notify_frame` counts + drops the frame (loud, never garbage)
+        from . import codec as _codec
+
+        _codec.decode_message(msg, self._codec, self.backend_name)
         pre = f"comm.{self.backend_name}"
         _mx.observe(f"{pre}.deserialize_s", time.perf_counter() - t0)
         _mx.inc(f"{pre}.bytes_recv", len(frame))

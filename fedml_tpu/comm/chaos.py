@@ -255,6 +255,11 @@ class ChaosTransport(BaseTransport, Observer):
     def receive_message(self, msg_type: str, msg: Message) -> None:
         self._notify(msg)        # inner -> our observers, unchanged
 
+    def set_codec(self, policy) -> None:
+        # raw-frame injection reads inner._encode_frame — the codec must
+        # sit there so corrupt/duplicate faults act on COMPRESSED frames
+        self.inner.set_codec(policy)
+
     def handle_receive_message(self) -> None:
         self.inner.handle_receive_message()
 

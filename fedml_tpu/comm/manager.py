@@ -115,7 +115,7 @@ def _wrap_transport(t: BaseTransport, chaos, retry_policy) -> BaseTransport:
 
 def create_transport(backend: str, rank: int, run_id: str = "default",
                      ip_table: Optional[dict] = None, chaos=None,
-                     comm_retry=None, **kw) -> BaseTransport:
+                     comm_retry=None, comm_codec=None, **kw) -> BaseTransport:
     """Backend factory (reference: _init_manager, fedml_comm_manager.py:131).
 
     chaos: FaultSpec or `common_args.extra.chaos` dict — wraps the transport
@@ -124,6 +124,11 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
     for defaults — wraps the stack in a ReliableTransport (seq/ack/
     retransmit/dedup, comm/reliable.py); for grpc it also supplies the
     default per-RPC deadline.
+    comm_codec: CodecPolicy or `comm_args.comm_codec` dict (ISSUE 14) —
+    attaches the wire codec plane to the INNERMOST transport, so chaos
+    injection and reliable retransmits both act on compressed frames.
+    Enable it on BOTH ends of a link: delta frames decode against the
+    receiving endpoint's anchor state.
     """
     policy = None
     if comm_retry is not None and comm_retry is not False:
@@ -131,9 +136,18 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
 
         policy = comm_retry if isinstance(comm_retry, RetryPolicy) \
             else RetryPolicy.from_dict(comm_retry)
+
+    def _with_codec(t: BaseTransport) -> BaseTransport:
+        if comm_codec is not None:
+            from .codec import CodecPolicy
+
+            t.set_codec(CodecPolicy.from_config(comm_codec))
+        return t
+
     b = (backend or "loopback").lower()
     if b == "loopback":
-        return _wrap_transport(LoopbackTransport(rank, run_id), chaos, policy)
+        return _wrap_transport(_with_codec(LoopbackTransport(rank, run_id)),
+                               chaos, policy)
     if b == "grpc":
         from .grpc_transport import GrpcTransport, load_ip_table
         if ip_table is None:
@@ -143,7 +157,8 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
             ip_table = load_ip_table(ip_table)
         if policy is not None:
             kw.setdefault("rpc_timeout_s", policy.rpc_timeout_s)
-        return _wrap_transport(GrpcTransport(rank, ip_table, **kw),
+        return _wrap_transport(_with_codec(GrpcTransport(rank, ip_table,
+                                                         **kw)),
                                chaos, policy)
     if b == "xla":
         raise ValueError(
@@ -156,7 +171,8 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
         # side-channel (comm/broker.py; reference MQTT+S3 shape)
         from .broker import BrokerTransport
 
-        return _wrap_transport(BrokerTransport(rank, run_id, **kw),
+        return _wrap_transport(_with_codec(BrokerTransport(rank, run_id,
+                                                           **kw)),
                                chaos, policy)
     if b in ("mqtt_web3", "mqtt_thetastore", "web3"):
         # decentralized-storage shape: content-addressed, hash-verified,
@@ -166,7 +182,8 @@ def create_transport(backend: str, rank: int, run_id: str = "default",
 
         if "broker" not in kw:
             kw["broker"] = get_cas_broker(run_id)
-        return _wrap_transport(BrokerTransport(rank, run_id, **kw),
+        return _wrap_transport(_with_codec(BrokerTransport(rank, run_id,
+                                                           **kw)),
                                chaos, policy)
     if b in ("trpc", "mpi"):
         raise ValueError(

@@ -201,6 +201,12 @@ class ReliableTransport(BaseTransport, Observer):
     def backend_name(self) -> str:
         return self.inner.backend_name
 
+    def set_codec(self, policy) -> None:
+        # the wire codec must live on the INNERMOST transport (whose
+        # _encode_frame/_decode_frame actually run); setting it here would
+        # silently leave frames dense
+        self.inner.set_codec(policy)
+
     def handle_receive_message(self) -> None:
         self.inner.handle_receive_message()
 

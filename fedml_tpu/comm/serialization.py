@@ -11,6 +11,12 @@ trust boundaries; here the wire format is:
 Pytrees are JSON with ndarray leaves swapped for {"__nd__": i, dtype, shape}
 descriptors pointing into the buffer region — zero-copy on encode (tobytes of
 C-contiguous arrays) and a single frombuffer per tensor on decode.
+
+This layer is representation only (lossless framing + integrity). Payload
+COMPRESSION lives one layer up: the wire codec plane (codec.py) rewrites a
+message's training payloads into self-describing compressed trees before
+they reach encode(), and this frame format carries them unchanged — sparse
+index/value arrays are just more ndarray leaves.
 """
 from __future__ import annotations
 

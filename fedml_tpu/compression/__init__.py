@@ -194,29 +194,80 @@ def make_compression_transform(
 
 
 # ---------------------------------------------------------------- wire codecs
-def encode_sparse(vec: np.ndarray, ratio: float) -> dict:
+# These are the wire-codec plane's kernels (comm/codec.py sparse_topk rides
+# them for every compressed training frame — ISSUE 14), so their edge cases
+# are load-bearing: zero-size leaves, keep-all ratios, and non-finite inputs
+# must behave deterministically instead of crashing or encoding garbage.
+def encode_sparse(vec: np.ndarray, ratio: float,
+                  val_dtype=np.float32) -> dict:
     """Host-side sparse wire format for cross-silo transport: top-k of a flat
-    update vector → {"idx": int32[k], "val": float32[k], "n": int}. Replaces
-    the reference's full pickled tensors over MQTT/S3/gRPC."""
+    update vector → {"idx": uint16/int32[k], "val": float[k], "n": int}.
+    Replaces the reference's full pickled tensors over MQTT/S3/gRPC.
+    `val_dtype=np.float16` halves the value bytes; under the wire codec's
+    error feedback the fp16 rounding error rides the residual, so it is
+    compensated next round rather than lost.
+
+    Edge contracts: a zero-size vector encodes to an empty frame; ratio -> 1
+    keeps everything (idx is then the identity, no argpartition on a full
+    slice); non-finite values are REFUSED — top-k by |value| over NaNs is
+    undefined and would silently pick garbage coordinates."""
     flat = np.asarray(vec).ravel()
-    k = _leaf_k(flat.size, ratio)
-    idx = np.argpartition(np.abs(flat), -k)[-k:].astype(np.int32)
-    return {"idx": idx, "val": flat[idx].astype(np.float32), "n": int(flat.size)}
+    if flat.size == 0:
+        return {"idx": np.zeros(0, np.int32), "val": np.zeros(0, val_dtype),
+                "n": 0}
+    if not np.all(np.isfinite(flat)):
+        raise ValueError(
+            "encode_sparse: payload contains non-finite values (NaN/Inf) — "
+            "magnitude top-k over them is undefined; clean the update "
+            "before the wire")
+    k = min(int(flat.size), _leaf_k(flat.size, ratio))
+    # index width follows the leaf size: most model leaves fit uint16,
+    # which cuts the per-kept-element wire cost from 8 to 6 bytes (the
+    # dtype rides the tensor-native frame, so decode needs no flag)
+    idt = np.uint16 if flat.size <= np.iinfo(np.uint16).max + 1 else np.int32
+    if k >= flat.size:
+        idx = np.arange(flat.size, dtype=idt)        # keep-all
+    else:
+        idx = np.argpartition(np.abs(flat), -k)[-k:].astype(idt)
+    return {"idx": idx, "val": flat[idx].astype(val_dtype),
+            "n": int(flat.size)}
 
 
 def decode_sparse(enc: dict) -> np.ndarray:
-    out = np.zeros(enc["n"], np.float32)
-    out[enc["idx"]] = enc["val"]
+    """Inverse of encode_sparse, with the validation the codec plane leans
+    on: out-of-range/negative indices or an idx/val length mismatch raise
+    (a corrupted frame must fail loudly, never scatter into wrong slots)."""
+    n = int(enc["n"])
+    idx = np.asarray(enc["idx"])
+    val = np.asarray(enc["val"], np.float32)
+    if n < 0 or idx.shape != val.shape:
+        raise ValueError(
+            f"sparse frame malformed: n={n}, {idx.size} indices vs "
+            f"{val.size} values")
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
+        raise ValueError(
+            f"sparse frame indices out of range [0, {n}) — corrupted or "
+            "mis-templated payload")
+    out = np.zeros(n, np.float32)
+    out[idx] = val
     return out
 
 
 def encode_sparse_tree(tree, ratio: float) -> dict:
     """Per-leaf sparse encoding of a pytree update (the cross-device uplink
-    payload: top-k per leaf, flat order = jax.tree.leaves)."""
+    payload: top-k per leaf, flat order = jax.tree.leaves). Integer/bool
+    leaves ride DENSE (step counters, masks — magnitude top-k of discrete
+    state would corrupt it); float leaves sparsify."""
     import jax
 
-    leaves = jax.tree.leaves(tree)
-    return {"leaves": [encode_sparse(np.asarray(l), ratio) for l in leaves]}
+    out = []
+    for l in jax.tree.leaves(tree):
+        a = np.asarray(l)
+        if a.dtype.kind not in "f":
+            out.append({"dense": a, "n": int(a.size)})
+        else:
+            out.append(encode_sparse(a, ratio))
+    return {"leaves": out}
 
 
 def decode_sparse_tree(enc: dict, template) -> "object":
@@ -233,7 +284,13 @@ def decode_sparse_tree(enc: dict, template) -> "object":
     out = []
     for tl, el in zip(t_leaves, enc["leaves"]):
         n = int(np.size(tl))
-        if int(el["n"]) != n or np.any(np.asarray(el["idx"]) >= n) or \
+        if int(el["n"]) != n:
+            raise ValueError("sparse leaf size mismatch for template")
+        if "dense" in el:
+            d = np.asarray(el["dense"])
+            out.append(d.reshape(np.shape(tl)))
+            continue
+        if np.any(np.asarray(el["idx"]) >= n) or \
                 np.any(np.asarray(el["idx"]) < 0):
             raise ValueError("sparse leaf indices out of range for template")
         out.append(decode_sparse(el).reshape(np.shape(tl)))

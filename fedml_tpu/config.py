@@ -514,6 +514,39 @@ class Config:
             from .comm.reliable import RetryPolicy
 
             RetryPolicy.from_dict(cr)
+        # wire codec plane (ISSUE 14): `comm_args.comm_codec` is validated
+        # by its owning module against the CODEC_KNOBS registry (pure
+        # literal, graftlint's knob-drift rule cross-checks the consumer) —
+        # unknown keys, bad kinds, and knobs gated on an unselected codec
+        # all fail HERE, at load. The import is jax-free by design.
+        cc = self.comm_args.extra.get("comm_codec")
+        if cc is not None:
+            from .comm.codec import validate_comm_codec
+
+            validate_comm_codec(cc)
+            # secagg_premask_ratio only takes effect inside the secagg
+            # client (quantize-then-mask); without secagg it would be
+            # silently ignored — refuse at load (serve-knob discipline)
+            if cc.get("secagg_premask_ratio") is not None \
+                    and not t.extra.get("secagg"):
+                raise ValueError(
+                    "comm_codec.secagg_premask_ratio requires "
+                    "train_args.secagg — the pre-mask sparsifier lives in "
+                    "the secagg client; without it the knob would be "
+                    "silently ignored")
+        # DP on the cross-silo wire is wired into the PLAIN client only
+        # (dp.make_upload_dp -> FedClientManager); the secagg client has no
+        # noise stage, so enable_dp alongside secagg would silently upload
+        # UN-NOISED masked updates while the operator believes DP is on —
+        # refuse at load (same never-silently-ignored discipline)
+        if self.common_args.training_type == TRAINING_TYPE_CROSS_SILO \
+                and t.extra.get("secagg") and self.dp_args.enable_dp:
+            raise ValueError(
+                "dp_args.enable_dp cannot be combined with "
+                "train_args.secagg: the secagg client has no client-side "
+                "noise stage yet, so DP would be silently dropped — "
+                "disable one (noise-before-mask is the composition a "
+                "future PR can add behind this same check)")
         if self.common_args.training_type not in (
             TRAINING_TYPE_SIMULATION,
             TRAINING_TYPE_CROSS_SILO,

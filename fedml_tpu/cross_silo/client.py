@@ -42,11 +42,17 @@ class FedClientManager:
                  server_timeout_s: float = None,
                  reattach: bool = False,
                  heartbeat_s: float = None,
-                 max_reattach: int = 10):
+                 max_reattach: int = 10,
+                 dp_upload=None):
         self.comm = comm
         self.client_id = client_id
         self.server_id = server_id
         self.trainer = trainer
+        # client-side DP (dp.SiloUploadDP): clip+noise the local update
+        # BEFORE the send — the wire codec then compresses the NOISED
+        # payload (noise-then-compress; post-processing keeps the epsilon
+        # accounting unchanged — see dp/__init__.py SiloUploadDP)
+        self.dp_upload = dp_upload
         self.server_timeout_s = server_timeout_s
         self.reattach = reattach
         self.heartbeat_s = heartbeat_s
@@ -98,6 +104,11 @@ class FedClientManager:
                 new_params, n, metrics = self.trainer.train(params, round_idx)
         finally:
             self._training = False
+        if self.dp_upload is not None:
+            # DP noise FIRST, wire compression second (the transport codec
+            # runs at send time, downstream of here) — the ordering the
+            # accountant's post-processing argument depends on
+            new_params = self.dp_upload.apply(new_params, params, round_idx)
         # client-model publish on cadence (reference: core/mlops/__init__.py
         # :475 log_client_model_info); no-op without an artifact store
         from .. import mlops

@@ -562,11 +562,17 @@ class SecAggClientManager:
     def __init__(self, comm: FedCommManager, client_id: int,
                  trainer: SiloTrainer, num_clients: int,
                  client_ids: list[int], threshold: Optional[int] = None,
-                 server_id: int = 0, q_bits: int = 16, seed: int = 0):
+                 server_id: int = 0, q_bits: int = 16, seed: int = 0,
+                 premask_ratio: Optional[float] = None):
         self.comm = comm
         self.client_id = client_id
         self.server_id = server_id
         self.trainer = trainer
+        # quantize-then-mask compression (ISSUE 14,
+        # comm_codec.secagg_premask_ratio): lossy sparsify BEFORE the shared
+        # field quantization + mask — after masking the vector is uniform
+        # noise and nothing lossy may touch it (mpc/secagg.premask_sparsify)
+        self.premask_ratio = premask_ratio
         self.client_ids = list(client_ids)
         self.n = num_clients
         self.t = threshold if threshold is not None else max(1, self.n // 2)
@@ -663,6 +669,10 @@ class SecAggClientManager:
             new_params, n, _metrics = self.trainer.train(params, round_idx)
         # normalized weight n/N keeps the field budget count-scale-free
         vec = flatten_params(new_params) * (float(n) / self.weight_norm)
+        if self.premask_ratio is not None:
+            from ..mpc.secagg import premask_sparsify
+
+            vec = premask_sparsify(vec, self.premask_ratio)
         masked = self.sa.mask(vec, self.pks, round_salt=round_idx)
         out = Message(md.C2S_SA_MASKED, self.client_id, self.server_id)
         out.add(md.KEY_SA_MASKED, masked)

@@ -55,13 +55,19 @@ class SiloSoakHarness:
                  checkpoint_dir: Optional[str] = None, seed: int = 0,
                  run_id: Optional[str] = None,
                  server_kw: Optional[dict] = None,
-                 client_kw: Optional[dict] = None):
+                 client_kw: Optional[dict] = None,
+                 comm_codec: Optional[dict] = None):
         self.n_clients = n_clients
         self.rounds = rounds
         self.checkpoint_dir = checkpoint_dir
         self.run_id = run_id or f"soak-{uuid.uuid4().hex[:8]}"
         self.server_kw = dict(server_kw or {})
         self.client_kw = dict(client_kw or {})
+        # wire codec plane (ISSUE 14): every (re)started rank gets a FRESH
+        # CodecPolicy — exactly the process-death semantics (anchor rings
+        # and EF residuals die with the process; the next dense broadcast
+        # re-anchors, stale delta frames in the mailbox are loud-dropped)
+        self.comm_codec = comm_codec
         self.model = hub.create("lr", 3)
         self.targs = TrainArgs(
             epochs=2, batch_size=16, learning_rate=0.3,
@@ -76,7 +82,12 @@ class SiloSoakHarness:
 
     # ------------------------------------------------------------- plumbing
     def _comm(self, rank: int) -> FedCommManager:
-        return FedCommManager(LoopbackTransport(rank, self.run_id), rank)
+        t = LoopbackTransport(rank, self.run_id)
+        if self.comm_codec is not None:
+            from ..comm.codec import CodecPolicy
+
+            t.set_codec(CodecPolicy.from_config(self.comm_codec))
+        return FedCommManager(t, rank)
 
     def _trainer(self, cid: int) -> SiloTrainer:
         x, y = _client_data(cid)
@@ -196,7 +207,8 @@ def uninterrupted_final_params(n_clients: int = 2, rounds: int = 4,
 def chaos_kill_soak(spec, checkpoint_dir: str, n_clients: int = 2,
                     rounds: int = 5, seed: int = 0,
                     server_timeout_s: float = 0.5,
-                    timeout: float = 180.0) -> dict:
+                    timeout: float = 180.0,
+                    comm_codec: Optional[dict] = None) -> dict:
     """Drive a federation under a `FaultSpec.silo_kill` schedule
     ({rank: round} — rank 0 is the server): each scheduled rank is severed
     once the run has completed that many rounds, then restarted (the server
@@ -205,12 +217,18 @@ def chaos_kill_soak(spec, checkpoint_dir: str, n_clients: int = 2,
     between its upload and the next sync — so a full-participation run
     stays full-participation and the final params are bitwise-comparable
     to an uninterrupted run's.
+
+    `comm_codec` (ISSUE 14) runs the same soak over compressed frames —
+    restarted ranks start with empty codec state and re-anchor from the
+    resumed round's dense broadcast (final params then compare against a
+    codec-on uninterrupted run, not the dense one: lossy codecs change the
+    trajectory by design).
     """
     kills = dict(spec.silo_kill) if hasattr(spec, "silo_kill") \
         else dict(spec or {})
     h = SiloSoakHarness(
         n_clients=n_clients, rounds=rounds, checkpoint_dir=checkpoint_dir,
-        seed=seed,
+        seed=seed, comm_codec=comm_codec,
         server_kw=dict(round_timeout=10.0, quorum_frac=1.0),
         # generous re-attach budget: on a loaded box the restarted
         # server's checkpoint restore can take seconds, and a client that

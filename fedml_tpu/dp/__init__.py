@@ -184,3 +184,67 @@ def from_config(cfg: Config, counts: Optional[np.ndarray] = None) -> FedDP:
     t = cfg.train_args
     return FedDP(cfg.dp_args, t.client_num_per_round, t.client_num_in_total,
                  t.comm_round, counts=counts)
+
+
+class SiloUploadDP:
+    """Client-side DP for the cross-silo wire path (ISSUE 14): clip + noise
+    the local UPDATE (trained − received params) before the upload leaves
+    the trainer, then reassemble params = received + noised_update.
+
+    ORDERING CONTRACT with the wire codec (comm/codec.py): this runs
+    strictly BEFORE the transport encodes the frame, so the codec's lossy
+    sparsify/quantize is post-processing of the DP mechanism's output —
+    the RDP accountant is UNCHANGED by compression (DP is closed under
+    post-processing). The reverse order, compress-then-noise, would need a
+    fresh sensitivity analysis of the compressed mapping and is not
+    offered; tests/test_wire_codec.py pins both the ordering and the
+    epsilon invariance.
+
+    The noise rng is derived from (seed, round), so a durability re-send of
+    the same round re-noises to the IDENTICAL value — rejoin stays
+    deterministic, and the accountant steps only ONCE per distinct round
+    (a re-send releases no additional information, so re-stepping it would
+    overstate epsilon under chaotic re-attach weather)."""
+
+    def __init__(self, dp: FedDP, seed: int = 0):
+        self.dp = dp
+        self._f = dp.client_transform()
+        self.seed = int(seed)
+        self._stepped_rounds: set = set()
+
+    def apply(self, new_params: Pytree, base_params: Pytree,
+              round_idx: int) -> Pytree:
+        if self._f is None:
+            return new_params
+        from ..utils import metrics as _mx
+
+        delta = jax.tree.map(
+            lambda a, b: jnp.asarray(a) - jnp.asarray(b),
+            new_params, base_params)
+        rng = jax.random.fold_in(jax.random.key(self.seed), round_idx)
+        noised = self._f(delta, rng)
+        out = jax.tree.map(
+            lambda b, d: np.asarray(jnp.asarray(b) + d),
+            base_params, noised)
+        if round_idx not in self._stepped_rounds:
+            self._stepped_rounds.add(round_idx)
+            self.dp.step_round()
+        eps = self.dp.get_epsilon()
+        if np.isfinite(eps):
+            _mx.set_gauge("fed.client.dp_epsilon", eps)
+        return out
+
+    def epsilon(self) -> float:
+        return self.dp.get_epsilon()
+
+
+def make_upload_dp(cfg: Config, seed: int = 0) -> Optional[SiloUploadDP]:
+    """Build the cross-silo client's upload DP stage from dp_args, or None
+    when DP is off or server-side (cdp noises the AGGREGATE — it lands in
+    the server's postprocess hook, not on the client wire)."""
+    if not cfg.dp_args.enable_dp:
+        return None
+    sol = (cfg.dp_args.dp_solution_type or LDP).lower()
+    if sol == CDP:
+        return None
+    return SiloUploadDP(from_config(cfg), seed=seed)

@@ -156,3 +156,47 @@ def prg_mask(seed: int, size: int, p: int = DEFAULT_PRIME) -> np.ndarray:
     return np.random.default_rng(seed % (2**63)).integers(
         0, p, size=size, dtype=np.int64
     )
+
+
+# --------------------------------------------------- wire packing (ISSUE 14)
+# THE shared quantize-then-mask contract for the codec plane: compression of
+# a secagg upload must happen BEFORE masking (lossy sparsify of the float
+# vector, then `quantize(q_bits)` — the ONE field scale every client already
+# shares), because a masked vector is uniformly random in [0, p) and nothing
+# lossy can touch it without corrupting the unmasked sum. What the wire CAN
+# do losslessly is representation: the default prime fits 31 bits, so the
+# int64 field vectors that ride C2S_SA_MASKED pack into uint32 for an exact
+# 2x (comm/codec.py's `field_pack` codec consumes these two functions; the
+# roundtrip is bitwise, so the unmasked aggregate is bitwise unchanged —
+# pinned in tests/test_wire_codec.py).
+def pack_field(xq: np.ndarray, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Lossless uint32 wire packing of a field vector (values in [0, p),
+    p <= 2^32). Out-of-range values mean the input is NOT a reduced field
+    vector — refuse rather than truncate bits silently."""
+    if p > 2**32:
+        raise ValueError(
+            f"pack_field: prime {p} exceeds 32 bits — uint32 packing would "
+            "truncate; use the dense int64 representation")
+    a = np.asarray(xq)
+    if a.dtype.kind not in "iu":
+        raise ValueError(
+            f"pack_field expects integer field elements; got dtype {a.dtype}")
+    if a.size and (int(a.min()) < 0 or int(a.max()) >= p):
+        raise ValueError(
+            f"pack_field: values outside [0, {p}) — not a mod-p reduced "
+            "vector (mask before packing)")
+    return a.astype(np.uint32)
+
+
+def unpack_field(buf: np.ndarray, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Inverse of pack_field: uint32 wire form -> int64 field vector."""
+    a = np.asarray(buf)
+    if a.dtype != np.uint32:
+        raise ValueError(
+            f"unpack_field expects the uint32 wire form; got {a.dtype}")
+    out = a.astype(np.int64)
+    if out.size and int(out.max()) >= p:
+        raise ValueError(
+            f"unpack_field: values outside [0, {p}) — corrupted frame or "
+            "prime mismatch between sender and receiver")
+    return out

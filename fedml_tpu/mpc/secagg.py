@@ -41,6 +41,32 @@ from .finite import (
 _G = 5  # public DH generator (reference: my_pk_gen uses g**sk mod p)
 
 
+def premask_sparsify(x: np.ndarray, ratio: float) -> np.ndarray:
+    """Quantize-then-mask compression leg (ISSUE 14): keep the top-k |values|
+    of the float vector and zero the rest, BEFORE quantize+mask. Masked
+    vectors are uniformly random field elements, so lossy compression can
+    only live on this side of the mask; the kept coordinates then ride the
+    shared field scale (finite.quantize(q_bits)) unchanged, which is what
+    makes the masked compressed aggregate unmask to EXACTLY the plain
+    quantize-sum-dequantize of the same sparsified vectors. Numpy-only so
+    mpc/ stays jax-free."""
+    flat = np.asarray(x, np.float64).ravel()
+    if not 0.0 < float(ratio) <= 1.0:
+        raise ValueError(f"premask_sparsify ratio must be in (0, 1]; got "
+                         f"{ratio!r}")
+    if flat.size == 0:
+        return flat.reshape(np.shape(x))
+    if not np.all(np.isfinite(flat)):
+        raise ValueError("premask_sparsify: non-finite values in the update")
+    k = max(1, int(flat.size * float(ratio)))
+    if k >= flat.size:
+        return flat.reshape(np.shape(x))
+    idx = np.argpartition(np.abs(flat), -k)[-k:]
+    out = np.zeros_like(flat)
+    out[idx] = flat[idx]
+    return out.reshape(np.shape(x))
+
+
 def derive_round_key(seed: int, round_salt: int, label: bytes = b"mask") -> int:
     """Per-round PRG key: SHA-256(label || seed || salt) truncated to 62 bits.
 

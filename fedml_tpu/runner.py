@@ -106,9 +106,14 @@ class FedMLRunner:
         ip_table = cfg.comm_args.grpc_ipconfig_path or None
         run_id = cfg.comm_args.extra.get("run_id", "cs")
         # robustness stack (ISSUE 4): chaos injection + reliable delivery
-        # ride the same config keys every runtime reads
+        # ride the same config keys every runtime reads. The wire codec
+        # plane (ISSUE 14) rides comm_args.comm_codec on BOTH roles —
+        # delta frames decode against the receiving end's anchor state, so
+        # a one-sided codec would be a loud decode error, not savings.
+        codec_cfg = cfg.comm_args.extra.get("comm_codec")
         rel = dict(chaos=cfg.common_args.extra.get("chaos"),
-                   comm_retry=cfg.common_args.extra.get("comm_retry"))
+                   comm_retry=cfg.common_args.extra.get("comm_retry"),
+                   comm_codec=codec_cfg)
         if backend == "grpc":
             tr = create_transport(backend, rank, ip_table=ip_table, **rel)
         else:
@@ -177,10 +182,16 @@ class FedMLRunner:
         if secagg:
             from .cross_silo import SecAggClientManager
 
+            # quantize-then-mask (ISSUE 14): lossy sparsify BEFORE the
+            # shared field scale + mask; the wire leg (field_pack) is
+            # attached to the transport above
             return SecAggClientManager(
                 comm, rank, trainer, num_clients=len(client_ids),
-                client_ids=client_ids, **kw)
+                client_ids=client_ids,
+                premask_ratio=(codec_cfg or {}).get("secagg_premask_ratio"),
+                **kw)
         from .cross_silo import FedClientManager
+        from .dp import make_upload_dp
 
         # a resumable server implies re-attaching clients (they must
         # re-announce to the restarted incarnation); `reattach` overrides
@@ -188,7 +199,8 @@ class FedMLRunner:
             comm, rank, trainer,
             server_timeout_s=t.extra.get("server_timeout_s"),
             reattach=bool(t.extra.get("reattach", t.extra.get("resume"))),
-            heartbeat_s=t.extra.get("heartbeat_s"), **kw)
+            heartbeat_s=t.extra.get("heartbeat_s"),
+            dp_upload=make_upload_dp(cfg, seed=rank), **kw)
 
     # ---------------------------------------------------------- cross-device
     def _init_cross_device(self, dataset, model, role, rank, transport, **kw):

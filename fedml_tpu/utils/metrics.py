@@ -213,6 +213,23 @@ def percentile_from_counts(edges: Sequence[float], counts: Sequence[int],
     return observed_max if observed_max is not None else edges[-1]
 
 
+def percentile_from_snapshots(before: dict, after: dict, key: str,
+                              q: float) -> Optional[float]:
+    """q-quantile of ONE histogram over a measurement window: bucket-count
+    deltas between two cumulative `snapshot()` dicts. The shared helper for
+    every 'diff two snapshots' bench site (comm_bench's per-backend
+    columns, bench.py's codec rows) — the windowing math lives once, next
+    to percentile_from_counts."""
+    ha = (after.get("histograms") or {}).get(key)
+    if not ha:
+        return None
+    hb = (before.get("histograms") or {}).get(key)
+    counts = [a - (hb["counts"][i] if hb else 0)
+              for i, a in enumerate(ha["counts"])]
+    return percentile_from_counts(ha["edges"], counts, q,
+                                  observed_max=ha.get("max"))
+
+
 class MetricsRegistry:
     """Name -> instrument map; instruments are created once and cached, so
     module-level `inc(name)` costs a dict get after the first call."""

@@ -43,31 +43,47 @@ METRIC_PREFIX = {"loopback": "loopback", "grpc": "grpc",
                  "web3": "broker"}
 
 
+def _hist_percentile_delta(prefix_key: str, before: dict, after: dict,
+                           out: dict, labels) -> None:
+    """p50/p99 of one histogram over the bench window, in ms columns
+    (bucket-count deltas via metrics.percentile_from_snapshots)."""
+    for q, label in labels:
+        p = mx.percentile_from_snapshots(before, after, prefix_key, q)
+        if p is not None or prefix_key in after["histograms"]:
+            out[label] = round(p * 1e3, 4) if p is not None else None
+
+
 def _counter_deltas(prefix: str, before: dict, after: dict) -> dict:
     """Per-run comm counters/latency for one backend: diff two process-wide
     metrics snapshots (instruments are cumulative; the delta isolates this
     bench run). Returns bytes/msgs counters plus p50/p99 of the publish
-    latency histogram computed from bucket-count deltas."""
+    latency histogram computed from bucket-count deltas — and, when the
+    wire codec plane ran (ISSUE 14), its payload bytes_raw/bytes_wire
+    reduction and encode/decode latency percentiles."""
     out = {}
     for leg in ("bytes_sent", "msgs_sent", "bytes_recv", "msgs_recv"):
         k = f"comm.{prefix}.{leg}"
         out[leg] = (after["counters"].get(k, 0)
                     - before["counters"].get(k, 0))
-    hk = f"comm.{prefix}.publish_s"
-    ha = after["histograms"].get(hk)
-    if ha:
-        hb = before["histograms"].get(hk)
-        counts = [a - (hb["counts"][i] if hb else 0)
-                  for i, a in enumerate(ha["counts"])]
-        for q, label in ((0.5, "publish_ms_p50"), (0.99, "publish_ms_p99")):
-            p = mx.percentile_from_counts(ha["edges"], counts, q,
-                                          observed_max=ha.get("max"))
-            out[label] = round(p * 1e3, 4) if p is not None else None
+    _hist_percentile_delta(f"comm.{prefix}.publish_s", before, after, out,
+                           ((0.5, "publish_ms_p50"), (0.99, "publish_ms_p99")))
+    raw = (after["counters"].get(f"comm.codec.{prefix}.bytes_raw", 0)
+           - before["counters"].get(f"comm.codec.{prefix}.bytes_raw", 0))
+    wire = (after["counters"].get(f"comm.codec.{prefix}.bytes_wire", 0)
+            - before["counters"].get(f"comm.codec.{prefix}.bytes_wire", 0))
+    if raw and wire:
+        out["codec_bytes_raw"] = raw
+        out["codec_bytes_wire"] = wire
+        out["codec_reduction_x"] = round(raw / wire, 2)
+        _hist_percentile_delta(f"comm.codec.{prefix}.encode_s", before,
+                               after, out, ((0.5, "codec_encode_ms_p50"),))
+        _hist_percentile_delta(f"comm.codec.{prefix}.decode_s", before,
+                               after, out, ((0.5, "codec_decode_ms_p50"),))
     return out
 
 
-def _pair(backend: str, run_id: str):
-    kw = {}
+def _pair(backend: str, run_id: str, codec=None):
+    kw = {"comm_codec": codec} if codec is not None else {}
     if backend == "grpc":
         import socket
 
@@ -79,10 +95,10 @@ def _pair(backend: str, run_id: str):
         p0, p1 = free_port(), free_port()
         table = {0: f"127.0.0.1:{p0}", 1: f"127.0.0.1:{p1}"}
         a = FedCommManager(create_transport(
-            backend, 0, run_id, ip_table=table, port=p0), 0)
+            backend, 0, run_id, ip_table=table, port=p0, **kw), 0)
         try:
             b = FedCommManager(create_transport(
-                backend, 1, run_id, ip_table=table, port=p1), 1)
+                backend, 1, run_id, ip_table=table, port=p1, **kw), 1)
         except BaseException:
             # the retry loop in bench_backend would otherwise leak rank 0's
             # already-bound server thread into every later backend of the
@@ -100,18 +116,27 @@ def _pair(backend: str, run_id: str):
 
 
 def bench_backend(backend: str, payload_mb: float = 4.0, iters: int = 20,
-                  warmup: int = 3) -> dict:
+                  warmup: int = 3, codec=None) -> dict:
+    """One backend's rtt/throughput row. `codec` (a comm_codec knob dict,
+    ISSUE 14) attaches the wire codec plane and moves the bulk payload onto
+    the codec-eligible `model_params` key, adding bytes/round +
+    encode/decode-latency columns (codec_* keys) to the row."""
     run_id = f"commbench-{uuid.uuid4().hex[:6]}"
     # grpc port probing races other processes between probe and bind —
     # retry with fresh ports instead of flaking
+    if codec is not None:
+        codec = {**codec,
+                 "per_type": {**codec.get("per_type", {}),
+                              BULK: codec.get("kind", "sparse_topk")}}
     for attempt in range(3):
         try:
-            a, b = _pair(backend, run_id)
+            a, b = _pair(backend, run_id, codec=codec)
             break
         except Exception:  # noqa: BLE001
             if attempt == 2:
                 raise
     got = threading.Event()
+    bulk_key = "model_params" if codec is not None else "w"
 
     def on_echo_b(msg):             # rank1 echoes straight back
         m = Message(ECHO, 1, 0)
@@ -123,7 +148,7 @@ def bench_backend(backend: str, payload_mb: float = 4.0, iters: int = 20,
 
     b.register_message_receive_handler(ECHO, on_echo_b)
     b.register_message_receive_handler(
-        BULK, lambda m: (np.asarray(m.get("w")), got.set()))
+        BULK, lambda m: (np.asarray(m.get(bulk_key)), got.set()))
     a.register_message_receive_handler(ECHO, on_any_a)
     a.run(background=True)
     b.run(background=True)
@@ -149,7 +174,9 @@ def bench_backend(backend: str, payload_mb: float = 4.0, iters: int = 20,
     def bulk_once() -> float:
         got.clear()
         m = Message(BULK, 0, 1)
-        m.add("w", w)
+        # under a codec the tensor rides the codec-eligible payload key
+        # (a fresh dict per send: encode replaces the value in place)
+        m.add(bulk_key, {"w": w} if codec is not None else w)
         t0 = time.perf_counter()
         a.send_message(m)
         _await(120, "bulk")
@@ -180,6 +207,7 @@ def bench_backend(backend: str, payload_mb: float = 4.0, iters: int = 20,
             release_broker(run_id)
     return {
         "backend": backend,
+        **({"codec": codec.get("kind")} if codec is not None else {}),
         "rtt_ms_p50": round(rtt_p50 * 1e3, 3),
         "payload_mb": round(w.nbytes / 2**20, 2),
         "throughput_mb_s": round(w.nbytes / 2**20 / best, 1),
@@ -199,15 +227,29 @@ def main() -> int:
     ap.add_argument("--mb", type=float, default=16.0)
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--backends", default=",".join(BACKENDS))
+    ap.add_argument("--codecs", default="sparse_topk,qsgd",
+                    help="comma-separated wire codec kinds to bench per "
+                         "backend on top of the dense lane ('' = none); "
+                         "columns: codec_bytes_raw/wire, codec_reduction_x, "
+                         "codec_{encode,decode}_ms_p50")
+    ap.add_argument("--ratio", type=float, default=0.05,
+                    help="sparse_topk keep fraction for the codec lanes")
     args = ap.parse_args()
     rows = []
+    codec_lanes = [None] + [
+        {"kind": k, **({"ratio": args.ratio} if k == "sparse_topk" else {})}
+        for k in args.codecs.split(",") if k]
     for be in args.backends.split(","):
-        try:
-            rows.append(bench_backend(be, args.mb, args.iters))
-        except Exception as e:  # noqa: BLE001
-            rows.append({"backend": be,
-                         "error": f"{type(e).__name__}: {e}"[:160]})
-        print(json.dumps(rows[-1]))
+        for codec in codec_lanes:
+            try:
+                rows.append(bench_backend(be, args.mb, args.iters,
+                                          codec=codec))
+            except Exception as e:  # noqa: BLE001
+                rows.append({"backend": be,
+                             **({"codec": codec.get("kind")}
+                                if codec else {}),
+                             "error": f"{type(e).__name__}: {e}"[:160]})
+            print(json.dumps(rows[-1]))
     return 0 if all("error" not in r for r in rows) else 1
 
 

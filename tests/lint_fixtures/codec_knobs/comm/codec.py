@@ -1,0 +1,20 @@
+"""Fixture: drifted comm_codec registry (knob-drift codec leg)."""
+
+CODEC_KNOBS = {
+    "kind":  {"kind": "choice", "choices": ["dense"], "consumer": "policy"},
+    "ratio": {"kind": "num", "strict": True, "consumer": "policy"},
+    "gamma": {"kind": "num", "strict": True, "consumer": "policy"},  # FINDING: never read
+}
+
+
+def validate_comm_codec(extra):
+    for k in extra:
+        if k not in CODEC_KNOBS:
+            raise ValueError(k)
+
+
+def make_policy(d):
+    kind = d.get("kind")
+    ratio = d.get("ratio")
+    rogue = d.get("delta_knob")          # FINDING: not registered
+    return (kind, ratio, rogue)

@@ -72,6 +72,57 @@ def test_wire_roundtrip():
     assert len(nz[0]) == max(1, int(256 * 0.1))
 
 
+# The wire codec plane (comm/codec.py) rides encode_sparse/decode_sparse
+# for every compressed training frame (ISSUE 14), which makes these edge
+# cases load-bearing rather than theoretical.
+def test_encode_sparse_keep_all_ratio_one():
+    v = np.random.RandomState(1).randn(50).astype(np.float32)
+    enc = C.encode_sparse(v, 1.0)
+    assert enc["idx"].size == 50
+    np.testing.assert_array_equal(C.decode_sparse(enc), v)
+
+
+def test_encode_sparse_zero_size_leaf():
+    enc = C.encode_sparse(np.zeros(0, np.float32), 0.5)
+    assert enc["n"] == 0 and enc["idx"].size == 0
+    assert C.decode_sparse(enc).size == 0
+
+
+def test_encode_sparse_refuses_non_finite():
+    v = np.asarray([1.0, np.nan, 2.0], np.float32)
+    with pytest.raises(ValueError, match="non-finite"):
+        C.encode_sparse(v, 0.5)
+    with pytest.raises(ValueError, match="non-finite"):
+        C.encode_sparse(np.asarray([np.inf, 1.0]), 0.5)
+
+
+def test_decode_sparse_validates_frames():
+    enc = C.encode_sparse(np.arange(8, dtype=np.float32), 0.5)
+    bad = {**enc, "idx": np.asarray(enc["idx"], np.int64) + 100}
+    with pytest.raises(ValueError, match="out of range"):
+        C.decode_sparse(bad)
+    with pytest.raises(ValueError, match="malformed"):
+        C.decode_sparse({**enc, "val": np.zeros(enc["val"].size + 1,
+                                                np.float32)})
+
+
+def test_sparse_tree_int_bool_leaves_ride_dense():
+    tree = {
+        "w": np.random.RandomState(2).randn(6, 4).astype(np.float32),
+        "steps": np.arange(5, dtype=np.int32),
+        "flags": np.asarray([True, False, True]),
+    }
+    enc = C.encode_sparse_tree(tree, 0.25)
+    dec = C.decode_sparse_tree(enc, tree)
+    # discrete state survives exactly — magnitude top-k never touched it
+    np.testing.assert_array_equal(dec["steps"], tree["steps"])
+    np.testing.assert_array_equal(np.asarray(dec["flags"], bool),
+                                  tree["flags"])
+    # float leaf sparsified with exact kept values
+    nz = np.nonzero(dec["w"])
+    np.testing.assert_allclose(dec["w"][nz], tree["w"][nz])
+
+
 def test_registry_dispatch():
     assert C.make_compression_transform("none") is None
     f = C.make_compression_transform("topk", ratio=0.5)

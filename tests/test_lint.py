@@ -135,6 +135,24 @@ def test_knob_drift_fixture():
     assert any("hand-synced copy" in m for m in msgs)
 
 
+def test_knob_drift_codec_leg_fixture():
+    """The wire-codec half of knob-drift (ISSUE 14): a registered knob
+    `make_policy` never reads, an unregistered knob it does read, a config
+    that bypasses validate_comm_codec, and a resurrected hand-synced key
+    list all surface. The real tree's codec plane passes via the
+    zero-findings gate."""
+    findings, _stats = _lint_fixture("codec_knobs", "knob-drift")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 4, msgs
+    assert any("knob `gamma`" in m and "validated-then-dropped" in m
+               and "comm/codec.py CODEC_KNOBS" in m for m in msgs)
+    assert any("knob `delta_knob`" in m and "does not register" in m
+               for m in msgs)
+    assert any("does not validate comm_codec through comm/codec.py" in m
+               for m in msgs)
+    assert any("hand-synced copy" in m and "CODEC_KNOBS" in m for m in msgs)
+
+
 def test_knob_drift_suppressed_and_clean():
     findings, stats = _lint_fixture("knobs_suppressed", "knob-drift")
     assert findings == [] and stats["suppressed"] == 5

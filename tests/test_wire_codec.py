@@ -1,0 +1,612 @@
+"""Wire codec plane (ISSUE 14): compressed training frames under chaos,
+secagg, and DP.
+
+Pins the subsystem's contracts:
+- self-describing frames decode without out-of-band config; mismatches
+  (unknown codec, version skew, unknown delta anchor, one-sided deploy)
+  are LOUD errors, never silent garbage;
+- control/handshake/heartbeat frames stay byte-identical to a codec-less
+  build;
+- delta + error-feedback stream state is exact (recon == anchor + sparse,
+  residual = what top-k dropped) and idempotent under re-encode;
+- exactly-once dispatch survives chaos drop/dup/corrupt over COMPRESSED
+  frames, and the kill–restart soak stays green with the codec on;
+- quantize-then-mask: the secagg'd compressed aggregate is BITWISE equal
+  to the plain quantize-sum-dequantize of the same sparsified vectors,
+  and the packed (uint32) wire path equals the unpacked path bit for bit;
+- DP ordering: noise-then-compress — the codec sees the NOISED update and
+  the RDP accountant is unchanged by compression.
+"""
+import copy
+import threading
+import time
+import uuid
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import FedCommManager, Message, create_transport
+from fedml_tpu.comm.chaos import ChaosTransport, FaultSpec
+from fedml_tpu.comm.codec import (
+    CodecPolicy, decode_message, make_policy, tree_digest,
+    validate_comm_codec,
+)
+from fedml_tpu.comm.loopback import LoopbackTransport, release_router
+from fedml_tpu.comm.reliable import ReliableTransport, RetryPolicy
+from fedml_tpu.compression import decode_sparse, encode_sparse
+from fedml_tpu.config import Config, TrainArgs
+from fedml_tpu.cross_silo import (
+    FedClientManager, FedServerManager, SiloTrainer,
+)
+from fedml_tpu.models import hub
+from fedml_tpu.utils import metrics as mx
+
+
+def _mk_data(seed, n=64, d=8, k=3):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, k)
+    x = rs.randn(n, d).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def _roundtrip(msg, sender_pol, receiver_pol, backend="loopback"):
+    """encode on the sender's policy -> wire bytes -> decode on the
+    receiver's — the exact path BaseTransport._encode/_decode_frame runs."""
+    if sender_pol is not None:
+        sender_pol.encode_message(msg, backend)
+    out = Message.decode(msg.encode())
+    decode_message(out, receiver_pol, backend)
+    return out
+
+
+# ------------------------------------------------------------- unit: codecs
+def test_sparse_abs_mode_pinned_and_counted():
+    """A non-anchored message type compresses in absolute mode; the decoded
+    payload equals decode(encode(.)) bit for bit and the sender-side byte
+    counters record the reduction."""
+    pol = make_policy({"kind": "sparse_topk", "ratio": 0.25,
+                       "per_type": {"probe": "sparse_topk"}})
+    w = np.random.RandomState(0).randn(300).astype(np.float32)
+    snap0 = mx.snapshot()["counters"]
+    out = _roundtrip(Message("probe", 0, 1, {"model_params": {"w": w}}),
+                     pol, None)
+    want = decode_sparse(encode_sparse(w, 0.25))
+    assert np.array_equal(out.get("model_params")["w"], want)
+    snap1 = mx.snapshot()["counters"]
+    raw = snap1.get("comm.codec.loopback.bytes_raw", 0) \
+        - snap0.get("comm.codec.loopback.bytes_raw", 0)
+    wire = snap1.get("comm.codec.loopback.bytes_wire", 0) \
+        - snap0.get("comm.codec.loopback.bytes_wire", 0)
+    assert 0 < wire < raw
+
+
+def test_delta_anchor_and_error_feedback_stream():
+    """The bidirectional model stream: a dense broadcast anchors both ends,
+    the upload deltas against it, EF keeps what top-k dropped, and both
+    rings advance identically (same digests)."""
+    srv, cli = (make_policy({"kind": "sparse_topk", "ratio": 0.25})
+                for _ in range(2))
+    rs = np.random.RandomState(1)
+    G = {"w": rs.randn(40, 8).astype(np.float32),
+         "b": rs.randn(8).astype(np.float32)}
+    _roundtrip(Message("s2c_init_config", 0, 1, {"model_params": G}),
+               srv, cli)
+    P = {"w": G["w"] + 0.01 * rs.randn(40, 8).astype(np.float32),
+         "b": (G["b"] + 0.1).astype(np.float32)}
+    up = Message("c2s_send_model", 1, 0, {"model_params": P})
+    cli.encode_message(up, "loopback")
+    hdr = up.get("model_params")
+    assert hdr["__wire_codec__"] == "sparse_topk" and hdr["mode"] == "delta"
+    dec = _roundtrip_decode(up, srv)
+    # server reconstruction = G + sparse(delta), exactly
+    delta_ref = {k: P[k] - G[k] for k in P}
+    for k in P:
+        want = G[k] + decode_sparse(
+            encode_sparse(delta_ref[k].ravel(), 0.25)).reshape(
+                P[k].shape).astype(np.float32)
+        assert np.array_equal(dec[k], want)
+    # EF residual is exactly what the wire dropped
+    res = cli._residuals[(0, "model_params")]
+    for k in P:
+        np.testing.assert_allclose(res[k] + (dec[k] - G[k]), delta_ref[k],
+                                   atol=1e-6)
+    # both rings hold the same newest anchor
+    assert cli._latest_anchor(0, "model_params")[0] == \
+        srv._latest_anchor(1, "model_params")[0] == tree_digest(
+            {"w": np.asarray(dec["w"]), "b": np.asarray(dec["b"])})
+    # round 2: the residual rides into the next delta (different wire than
+    # a residual-less encode of the same payload)
+    G2 = {k: np.asarray(dec[k]) for k in dec}
+    _roundtrip(Message("s2c_sync_model", 0, 1, {"model_params": G2}),
+               srv, cli)
+    P2 = {"w": (G2["w"] + 0.005).astype(np.float32), "b": G2["b"]}
+    up2 = Message("c2s_send_model", 1, 0, {"model_params": P2})
+    cli.encode_message(up2, "loopback")
+    no_ef = make_policy({"kind": "sparse_topk", "ratio": 0.25,
+                         "error_feedback": False})
+    no_ef.record_decoded_anchor(0, "model_params", G2)
+    up2_ref = Message("c2s_send_model", 1, 0, {"model_params": dict(P2)})
+    no_ef.encode_message(up2_ref, "loopback")
+    v_ef = up2.get("model_params")["tree"]["w"]["__sp__"]["val"]
+    v_ref = up2_ref.get("model_params")["tree"]["w"]["__sp__"]["val"]
+    assert not np.array_equal(v_ef, v_ref)
+
+
+def _roundtrip_decode(encoded_msg, receiver_pol):
+    out = Message.decode(encoded_msg.encode())
+    decode_message(out, receiver_pol, "loopback")
+    return out.get("model_params")
+
+
+def test_encode_is_idempotent_per_message():
+    """A retransmit re-entering _encode_frame must not re-encode (and must
+    not double-spend the EF residual): the second pass is a no-op."""
+    pol = make_policy({"kind": "sparse_topk", "ratio": 0.5,
+                       "per_type": {"probe": "sparse_topk"}})
+    m = Message("probe", 0, 1,
+                {"model_params": {"w": np.ones(64, np.float32)}})
+    pol.encode_message(m, "loopback")
+    first = copy.deepcopy(m.params["model_params"])
+    pol.encode_message(m, "loopback")      # retransmit path
+    np.testing.assert_equal(m.params["model_params"], first)
+
+
+def test_mismatches_are_loud_not_garbage():
+    pol = make_policy({"kind": "sparse_topk", "ratio": 0.5})
+    G = {"w": np.ones(16, np.float32)}
+    _roundtrip(Message("s2c_init_config", 0, 1, {"model_params": G}),
+               pol, pol)
+    up = Message("c2s_send_model", 1, 0,
+                 {"model_params": {"w": (G["w"] + 1).astype(np.float32)}})
+    pol.encode_message(up, "loopback")
+    frame = up.encode()
+
+    # unknown codec id
+    bad = Message.decode(frame)
+    bad.params["model_params"]["__wire_codec__"] = "zstd_v9"
+    with pytest.raises(ValueError, match="codec mismatch"):
+        decode_message(bad, pol, "loopback")
+    # wire-version skew
+    bad = Message.decode(frame)
+    bad.params["model_params"]["v"] = 99
+    with pytest.raises(ValueError, match="version mismatch"):
+        decode_message(bad, pol, "loopback")
+    # delta frame on an endpoint with no codec state (one-sided deploy)
+    with pytest.raises(ValueError, match="no codec state"):
+        decode_message(Message.decode(frame), None, "loopback")
+    # delta frame whose anchor digest matches nothing
+    bad = Message.decode(frame)
+    bad.params["model_params"]["anchor"] = "deadbeefdeadbeef"
+    with pytest.raises(ValueError, match="anchor mismatch"):
+        decode_message(bad, pol, "loopback")
+    # corrupted sparse indices are rejected by the decoder's validation
+    bad = Message.decode(frame)
+    sp = bad.params["model_params"]["tree"]["w"]["__sp__"]
+    sp["idx"] = np.asarray(sp["idx"]).astype(np.int32) + 1000
+    with pytest.raises(ValueError, match="out of range"):
+        decode_message(bad, pol, "loopback")
+
+
+def test_control_frames_byte_identical():
+    """Handshake/heartbeat/status — and the default-dense S2C broadcast —
+    produce byte-identical frames with and without the codec plane."""
+    pol = make_policy({"kind": "sparse_topk", "ratio": 0.1})
+    G = {"w": np.random.RandomState(2).randn(32).astype(np.float32)}
+    msgs = [
+        Message("connection_ready", 1, 0),
+        Message("c2s_heartbeat", 1, 0, {"run_gen": 3}),
+        Message("c2s_client_status", 1, 0, {"client_status": "ONLINE"}),
+        Message("s2c_check_client_status", 0, 1),
+        Message("s2c_sync_model", 0, 1, {"model_params": G, "round_idx": 2}),
+    ]
+    for m in msgs:
+        plain = copy.deepcopy(m).encode()
+        pol.encode_message(m, "loopback")
+        assert m.encode() == plain, m.type
+
+
+def test_qsgd_and_val_bits_roundtrip():
+    pol = make_policy({"kind": "qsgd", "bits": 8,
+                       "per_type": {"probe": "qsgd"}})
+    w = np.random.RandomState(3).randn(500).astype(np.float32)
+    out = _roundtrip(Message("probe", 0, 1, {"model_params": {"w": w}}),
+                     pol, None)
+    got = out.get("model_params")["w"]
+    norm = float(np.linalg.norm(w))
+    assert got.dtype == np.float32 and got.shape == w.shape
+    # error bounded by one quantization level of the leaf norm
+    assert float(np.abs(got - w).max()) <= norm / (2**8 - 1) + 1e-6
+    # fp16 sparse values round-trip through the wire exactly as fp16
+    enc = encode_sparse(w, 0.5, val_dtype=np.float16)
+    assert enc["val"].dtype == np.float16
+    dec = decode_sparse(enc)
+    np.testing.assert_array_equal(
+        dec[np.asarray(enc["idx"], np.int64)],
+        w[np.asarray(enc["idx"], np.int64)].astype(np.float16)
+        .astype(np.float32))
+
+
+def test_field_pack_bitwise_and_refusals():
+    from fedml_tpu.mpc.finite import DEFAULT_PRIME, pack_field, unpack_field
+
+    pol = make_policy({"kind": "dense"})   # field_pack rides any codec cfg
+    v = np.random.RandomState(4).randint(
+        0, DEFAULT_PRIME, size=512).astype(np.int64)
+    out = _roundtrip(Message("c2s_sa_masked", 1, 0, {"sa_masked": v}),
+                     pol, None)
+    got = out.get("sa_masked")
+    assert got.dtype == np.int64 and np.array_equal(got, v)
+    assert np.array_equal(unpack_field(pack_field(v)), v)
+    with pytest.raises(ValueError, match="outside"):
+        pack_field(np.asarray([-1, 5], np.int64))
+    with pytest.raises(ValueError, match="truncate"):
+        pack_field(v, p=2**33)
+    with pytest.raises(ValueError, match="integer field"):
+        pol.encode_message(
+            Message("c2s_sa_masked", 1, 0,
+                    {"sa_masked": np.ones(4, np.float32)}), "loopback")
+
+
+# ----------------------------------------------------------- config surface
+def test_comm_codec_config_validation():
+    ok = {"kind": "sparse_topk", "ratio": 0.1, "error_feedback": True,
+          "val_bits": 16, "per_type": {"s2c_sync_model": "dense"}}
+    validate_comm_codec(ok)
+    with pytest.raises(ValueError, match="unknown comm_codec knob"):
+        validate_comm_codec({"kind": "sparse_topk", "ratioo": 0.1})
+    with pytest.raises(ValueError, match="needs a 'kind'"):
+        validate_comm_codec({"ratio": 0.1})
+    with pytest.raises(ValueError, match="must be one of"):
+        validate_comm_codec({"kind": "gzip"})
+    # gating: a knob owned by an unselected codec kind is refused
+    with pytest.raises(ValueError, match="requires kind: sparse_topk"):
+        validate_comm_codec({"kind": "qsgd", "ratio": 0.1})
+    with pytest.raises(ValueError, match="requires kind: qsgd"):
+        validate_comm_codec({"kind": "sparse_topk", "ratio": 0.1, "bits": 4})
+    # ...unless a per_type override selects that kind somewhere
+    validate_comm_codec({"kind": "qsgd", "ratio": 0.1,
+                         "per_type": {"c2s_send_model": "sparse_topk"}})
+    with pytest.raises(ValueError, match="per_type"):
+        validate_comm_codec({"kind": "dense",
+                             "per_type": {"x": "bogus"}})
+    # full config path: comm_args.comm_codec validated at load
+    base = {"train_args": {"client_num_in_total": 2,
+                           "client_num_per_round": 2}}
+    Config.from_dict({**base, "comm_args": {
+        "comm_codec": {"kind": "sparse_topk", "ratio": 0.1}}})
+    with pytest.raises(ValueError, match="unknown comm_codec knob"):
+        Config.from_dict({**base, "comm_args": {
+            "comm_codec": {"kind": "dense", "ratioz": 1}}})
+    # secagg_premask_ratio without secagg would be silently ignored
+    with pytest.raises(ValueError, match="requires\\s+train_args.secagg"):
+        Config.from_dict({**base, "comm_args": {
+            "comm_codec": {"kind": "dense", "secagg_premask_ratio": 0.1}}})
+    Config.from_dict({
+        "train_args": {**base["train_args"], "secagg": True},
+        "comm_args": {"comm_codec": {"kind": "dense",
+                                     "secagg_premask_ratio": 0.1}}})
+    # DP + secagg on cross-silo would silently upload un-noised updates
+    # (the secagg client has no noise stage) — refused at load
+    with pytest.raises(ValueError, match="secagg client has no client-side"):
+        Config.from_dict({
+            "common_args": {"training_type": "cross_silo"},
+            "train_args": {**base["train_args"], "secagg": True},
+            "dp_args": {"enable_dp": True, "epsilon": 0.9}})
+
+
+def test_create_transport_attaches_codec_to_innermost():
+    run = f"codec-wire-{uuid.uuid4().hex[:6]}"
+    t = create_transport(
+        "loopback", 0, run,
+        chaos={"drop": 0.1, "seed": 1}, comm_retry=True,
+        comm_codec={"kind": "sparse_topk", "ratio": 0.5})
+    assert isinstance(t, ReliableTransport)
+    assert isinstance(t.inner, ChaosTransport)
+    base = t.inner.inner
+    assert isinstance(base, LoopbackTransport)
+    assert isinstance(base._codec, CodecPolicy)
+    # set_codec through the wrapper stack reaches the innermost transport
+    t.set_codec(None)
+    assert base._codec is None
+    t.stop_receive_message()
+    release_router(run)
+
+
+# --------------------------------------------- chaos over compressed frames
+def test_exactly_once_under_chaos_over_compressed_frames():
+    """Drop/dup/corrupt injection + reliable delivery over SPARSE frames:
+    every payload dispatched exactly once and equal to the sender-side
+    reconstruction."""
+    run = f"codec-chaos-{uuid.uuid4().hex[:6]}"
+    spec = FaultSpec(seed=7, drop=0.15, duplicate=0.2, corrupt=0.15)
+    pol = RetryPolicy(ack_timeout_s=0.05, max_attempts=10, deadline_s=20.0)
+    cc = {"kind": "sparse_topk", "ratio": 0.25,
+          "per_type": {"probe": "sparse_topk"}}
+
+    def mk(r):
+        return create_transport("loopback", r, run, chaos=spec,
+                                comm_retry=pol, comm_codec=cc)
+
+    a, b = FedCommManager(mk(0), 0), FedCommManager(mk(1), 1)
+    got: dict = {}
+    done = threading.Event()
+    n = 14
+    rs = np.random.RandomState(5)
+    payloads = [rs.randn(129).astype(np.float32) for _ in range(n)]
+
+    def on_probe(m):
+        got.setdefault(int(m.get("i")), []).append(
+            np.asarray(m.get("model_params")["w"]))
+        if len(got) >= n:
+            done.set()
+
+    b.register_message_receive_handler("probe", on_probe)
+    a.run(background=True)
+    b.run(background=True)
+    try:
+        for i in range(n):
+            a.send_message(Message("probe", 0, 1)
+                           .add("i", i).add("model_params",
+                                            {"w": payloads[i]}))
+        assert done.wait(timeout=20), f"delivered {len(got)}/{n}"
+        time.sleep(0.1)
+        assert all(len(v) == 1 for v in got.values()), "dispatched twice"
+        for i in range(n):
+            want = decode_sparse(encode_sparse(payloads[i], 0.25))
+            assert np.array_equal(got[i][0], want)
+    finally:
+        a.stop()
+        b.stop()
+        release_router(run)
+
+
+def test_cross_silo_federation_compressed_under_chaos():
+    """A 2-client federation trains to completion over sparse delta frames
+    WITH chaos drop/dup/corrupt injected under the reliable layer — the
+    chaos-soak-over-compressed-frames acceptance bar."""
+    run = f"codec-fed-{uuid.uuid4().hex[:6]}"
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=2, batch_size=16, learning_rate=0.3,
+                  client_num_in_total=2, client_num_per_round=2,
+                  comm_round=3)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+    spec = FaultSpec(seed=9, drop=0.1, duplicate=0.1, corrupt=0.1)
+    rpol = RetryPolicy(ack_timeout_s=0.1, max_attempts=10, deadline_s=30.0)
+    cc = {"kind": "sparse_topk", "ratio": 0.3, "error_feedback": True}
+
+    def mk(r):
+        return FedCommManager(create_transport(
+            "loopback", r, run, chaos=spec, comm_retry=rpol,
+            comm_codec=cc), r)
+
+    snap0 = mx.snapshot()["counters"]
+    evals = [_mk_data(s) for s in (1, 2)]
+
+    def eval_fn(p, r):
+        import jax.numpy as jnp
+        pj = jax.tree.map(jnp.asarray, p)
+        accs = []
+        for x, y in evals:
+            logits = model.apply({"params": pj}, jnp.asarray(x))
+            accs.append(float((jnp.argmax(logits, -1)
+                               == jnp.asarray(y)).mean()))
+        return {"test_acc": float(np.mean(accs))}
+
+    server = FedServerManager(mk(0), client_ids=[1, 2],
+                              init_params=params_np, num_rounds=3,
+                              eval_fn=eval_fn)
+    clients = [
+        FedClientManager(mk(cid), cid,
+                         SiloTrainer(model.apply, t, *evals[cid - 1],
+                                     seed=cid))
+        for cid in (1, 2)]
+    server.run(background=True)
+    for c in clients:
+        c.run(background=True)
+        c.announce_ready()
+    assert server.done.wait(timeout=120), "compressed chaos run stalled"
+    for c in clients:
+        c.done.wait(timeout=20)
+    release_router(run)
+    assert len(server.history) == 3
+    # it actually learned over sparse deltas
+    assert server.history[-1]["test_acc"] > 0.6, server.history
+    snap1 = mx.snapshot()["counters"]
+    raw = snap1.get("comm.codec.loopback.bytes_raw", 0) \
+        - snap0.get("comm.codec.loopback.bytes_raw", 0)
+    wire = snap1.get("comm.codec.loopback.bytes_wire", 0) \
+        - snap0.get("comm.codec.loopback.bytes_wire", 0)
+    assert 0 < wire < raw
+    # chaos really fired over compressed frames
+    assert snap1.get("fed.chaos.corrupt", 0) > snap0.get(
+        "fed.chaos.corrupt", 0)
+
+
+def test_kill_restart_soak_over_compressed_frames(tmp_path):
+    """The ISSUE-10 kill–restart soak with the codec plane on: server
+    SIGKILL-severed mid-run and restarted with resume, every client killed
+    once — the run completes full-participation over sparse delta frames
+    (restarted ranks re-anchor from the next dense broadcast; stale delta
+    frames from the dead incarnation are loud-dropped, then re-served)."""
+    from fedml_tpu.cross_silo.soak import chaos_kill_soak
+
+    spec = FaultSpec(silo_kill={0: 2, 1: 1, 2: 3})
+    out = chaos_kill_soak(
+        spec, str(tmp_path / "ckpt"), n_clients=2, rounds=5, seed=0,
+        comm_codec={"kind": "sparse_topk", "ratio": 0.3,
+                    "error_feedback": True})
+    assert out["error"] is None, out["error"]
+    assert len(out["history"]) == 5
+    assert len(out["kills"]) == 3 and out["resumes"] >= 1, out["kills"]
+    assert all(r["n_received"] == 2 for r in out["history"]), out["history"]
+
+
+# ------------------------------------------------- secagg quantize-then-mask
+def test_quantize_then_mask_bitwise_vs_plain_path():
+    """The mpc-level contract: masked compressed vectors unmask to EXACTLY
+    the plain quantize-sum-dequantize of the same sparsified vectors."""
+    from fedml_tpu.mpc.finite import dequantize, quantize
+    from fedml_tpu.mpc.secagg import premask_sparsify, secagg_roundtrip
+
+    rs = np.random.RandomState(6)
+    vecs = [premask_sparsify(rs.randn(64), 0.25) for _ in range(4)]
+    masked_sum = secagg_roundtrip(vecs, seed=3)
+    plain = dequantize(
+        np.sum([quantize(v, 16) for v in vecs], axis=0) % (2**31 - 1), 16)
+    assert np.array_equal(masked_sum, plain)
+    # and with a dropout mid-protocol
+    masked_drop = secagg_roundtrip(vecs, drop=[2], seed=3)
+    plain_drop = dequantize(
+        np.sum([quantize(v, 16) for i, v in enumerate(vecs) if i != 2],
+               axis=0) % (2**31 - 1), 16)
+    assert np.array_equal(masked_drop, plain_drop)
+
+
+def test_secagg_federation_packed_wire_bitwise():
+    """End to end: the secagg federation with the codec plane (field_pack
+    on the masked upload + premask sparsify) produces final params BITWISE
+    equal to the same federation without any wire codec but the identical
+    premask — the wire leg is pure representation."""
+    from fedml_tpu.cross_silo import SecAggClientManager, SecAggServerManager
+
+    def run_once(tag, codec_cfg, premask):
+        run_id = f"codec-sa-{tag}-{uuid.uuid4().hex[:6]}"
+        n, rounds = 3, 2
+        model = hub.create("lr", 3)
+        t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+        params_np = jax.tree.map(
+            np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+        ids = list(range(1, n + 1))
+
+        def mk(r):
+            return FedCommManager(create_transport(
+                "loopback", r, run_id, comm_codec=codec_cfg), r)
+
+        server = SecAggServerManager(mk(0), client_ids=ids,
+                                     init_params=params_np,
+                                     num_rounds=rounds)
+        clients = [
+            SecAggClientManager(
+                mk(cid), cid,
+                SiloTrainer(model.apply, t, *_mk_data(cid), seed=100 + cid),
+                num_clients=n, client_ids=ids, premask_ratio=premask)
+            for cid in ids]
+        server.run(background=True)
+        for c in clients:
+            c.run(background=True)
+        for c in clients:
+            c.announce_ready()
+        assert server.done.wait(timeout=120), f"secagg {tag} stalled"
+        release_router(run_id)
+        return server.params
+
+    packed = run_once("packed", {"kind": "dense",
+                                 "secagg_premask_ratio": 0.25}, 0.25)
+    plain = run_once("plain", None, 0.25)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 packed, plain)
+
+
+# --------------------------------------------------- DP: noise-then-compress
+def test_dp_noise_then_compress_ordering_and_epsilon():
+    """The codec input IS the DP output (noise applied before the wire),
+    and the accountant's epsilon does not depend on the codec at all."""
+    from fedml_tpu.dp import make_upload_dp
+
+    cfg = Config.from_dict({
+        "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 4},
+        "dp_args": {"enable_dp": True, "dp_solution_type": "ldp",
+                    "epsilon": 0.9, "delta": 1e-5, "clipping_norm": 1.0},
+    })
+    x, y = _mk_data(1)
+    model = hub.create("lr", 3)
+    t = TrainArgs(epochs=1, batch_size=16, learning_rate=0.2)
+    trainer = SiloTrainer(model.apply, t, x, y, seed=1)
+    params_np = jax.tree.map(
+        np.asarray, hub.init_params(model, (8,), jax.random.key(0)))
+
+    sent = []
+
+    class _Spy:
+        def send_message(self, msg):
+            sent.append(msg)
+
+        def register_message_receive_handler(self, *_a):
+            pass
+
+    dp = make_upload_dp(cfg, seed=1)
+    cli = FedClientManager(_Spy(), 1, trainer, dp_upload=dp)
+    cli._train_and_send(params_np, 0, gen=0)
+    uploaded = sent[-1].get("model_params")
+    raw_trained, _n, _m = trainer.train(params_np, 0)
+    # the upload differs from the raw trained params (noise applied) ...
+    assert not all(
+        np.array_equal(a, b) for a, b in zip(
+            jax.tree.leaves(uploaded), jax.tree.leaves(raw_trained)))
+    # ... and equals a deterministic re-application of the same DP stage:
+    # the value handed to the wire codec IS the DP output
+    dp2 = make_upload_dp(cfg, seed=1)
+    want = dp2.apply(raw_trained, params_np, 0)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 uploaded, want)
+    # epsilon accounting is codec-independent: same steps, same epsilon,
+    # whether or not the payload then rode a lossy codec
+    pol = make_policy({"kind": "sparse_topk", "ratio": 0.25})
+    m = Message("c2s_send_model", 1, 0, {"model_params": uploaded})
+    pol.record_decoded_anchor(0, "model_params",
+                              jax.tree.map(np.asarray, params_np))
+    pol.encode_message(m, "loopback")
+    assert np.isclose(dp.epsilon(), dp2.epsilon())
+    assert dp.epsilon() > 0
+    # a durability RE-SEND of the same round re-noises to the identical
+    # value and does NOT re-step the accountant (no extra information is
+    # released); a genuinely new round does step it
+    eps_one = dp.epsilon()
+    again = dp.apply(raw_trained, params_np, 0)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                 again, want)
+    assert dp.epsilon() == eps_one
+    dp.apply(raw_trained, params_np, 1)
+    assert dp.epsilon() > eps_one
+
+
+def test_runner_plumbs_codec_and_dp(tmp_path):
+    """FedMLRunner builds cross-silo roles with the codec attached to the
+    innermost transport and the DP upload stage on the client."""
+    from fedml_tpu.runner import FedMLRunner
+
+    base = {
+        "common_args": {"training_type": "cross_silo"},
+        "train_args": {"client_num_in_total": 2, "client_num_per_round": 2,
+                       "comm_round": 2},
+        "comm_args": {"transport": "loopback",
+                      "run_id": f"codec-run-{uuid.uuid4().hex[:6]}",
+                      "comm_codec": {"kind": "sparse_topk", "ratio": 0.5}},
+        "dp_args": {"enable_dp": True, "dp_solution_type": "ldp",
+                    "epsilon": 0.9, "delta": 1e-5},
+    }
+    cfg = Config.from_dict(base)
+    x, y = _mk_data(0)
+    model = hub.create("lr", 3)
+    client = FedMLRunner(cfg, dataset=(x, y), model=model, role="client",
+                         rank=1).runner
+    assert client.dp_upload is not None
+    assert isinstance(client.comm.transport._codec, CodecPolicy)
+    server = FedMLRunner(cfg, model=model, role="server", rank=0,
+                         input_shape=(8,)).runner
+    assert isinstance(server.comm.transport._codec, CodecPolicy)
+    client.comm.transport.stop_receive_message()
+    server.comm.transport.stop_receive_message()
+    release_router(base["comm_args"]["run_id"])
+
+
+def test_diagnosis_codec_smoke_probe():
+    from fedml_tpu import api
+
+    out = api.fedml_diagnosis(only=["codec_smoke"])
+    assert out["checks"]["codec_smoke"]["ok"], out
+    assert out["checks"]["codec_smoke"]["reduction_x"] > 1.0
