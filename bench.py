@@ -653,6 +653,69 @@ def bench_cross_silo_durability(quick: bool = False) -> dict:
     }
 
 
+def bench_live_loop(quick: bool = False) -> dict:
+    """Live federation soak rows (ISSUE 15) — the repo's thesis as one
+    acceptance bar: a 10-round durable cross-silo federation trains the
+    serving model's LoRA adapters and publishes each round to the
+    artifact store; a 2-replica paged-engine fleet hot-swaps them in
+    behind the shedding gateway while seeded Zipf/heavy-tail loadgen
+    traffic (bursts above the shed watermark, unary + SSE) flows the
+    whole time; ONE FaultSpec timeline SIGKILLs the trainer server at
+    round 3, a trainer client at round 6, and a serving replica after
+    its 8th streamed token.
+
+    Bars: `live_loop_non2xx` == 0 (shed 429s excluded and bounded),
+    `live_loop_fleet_lag_max` <= 2 (fleet_version tracks the training
+    round), TTFT p99 under the SLO through every kill, and
+    `live_loop_round_to_serve_ms_p50` is the publish→fleet-converged
+    headline latency."""
+    import tempfile
+
+    from fedml_tpu.comm.chaos import FaultSpec
+    from fedml_tpu.soak.loadgen import TrafficSpec
+    from fedml_tpu.soak.loop import LiveLoopHarness
+
+    rate, dur = (4.0, 30.0) if quick else (6.0, 45.0)
+    slo = {"shed_frac_max": 0.4, "ttft_p99_slo_ms": 2000.0,
+           "lag_rounds_max": 2}
+    with tempfile.TemporaryDirectory() as store, \
+            tempfile.TemporaryDirectory() as ckpt:
+        h = LiveLoopHarness(
+            rounds=10, n_clients=2, n_replicas=2, seed=0,
+            store_dir=store, checkpoint_dir=ckpt, shed_watermark=6.0,
+            fault_spec=FaultSpec(silo_kill={0: 3, 2: 6},
+                                 replica_kill={0: 8}),
+            traffic=TrafficSpec(seed=0, vocab=32, rate_rps=rate,
+                                duration_s=dur, stream_frac=0.35,
+                                burst_every_s=5.0, burst_factor=6.0,
+                                burst_len_s=1.0),
+            slo=slo)
+        try:
+            rep = h.run(timeout=240, tail_s=2.0)
+        finally:
+            h.close()
+    return {
+        "live_loop_rounds": rep["rounds_done"],
+        "live_loop_requests": rep["requests"],
+        "live_loop_non2xx": rep["non2xx_excl_shed"],
+        "live_loop_shed_429s": rep["shed_429s"],
+        "live_loop_shed_frac": rep["shed_frac"],
+        "live_loop_ttft_p99_ms": rep["ttft_p99_ms"],
+        "live_loop_ttft_p50_ms": rep["ttft_p50_ms"],
+        "live_loop_round_to_serve_ms_p50": rep["round_to_serve_p50_ms"],
+        "live_loop_fleet_lag_max": rep["lag_max_seen"],
+        "live_loop_fleet_version": rep["fleet_version"],
+        "live_loop_rounds_per_s": rep["rounds_per_s"],
+        "live_loop_kills": rep["kills_executed"],
+        "live_loop_slo_ok": rep["slo_ok"],
+        "live_loop_ok": rep["loop_ok"],
+        "live_loop_config": (
+            "10 rounds 2 clients 2 replicas, kills silo{0:3,2:6} "
+            f"replica{{0:8}}, rate {rate}rps burst6x, watermark 6.0"
+            + (" quick" if quick else "")),
+    }
+
+
 def bench_serving_cb(quick: bool = False) -> dict:
     """Continuous-batching serving row (ISSUE 5): a concurrency-8
     synthetic decode workload — 8 prompts of assorted lengths, 24 new
@@ -1981,6 +2044,11 @@ _HEADLINE_KEYS = (
     # cross-silo durability (ISSUE 10): kill–restart recovery + eviction
     "cross_silo_recovery_s", "cross_silo_recovery_bitwise",
     "cross_silo_evict_saved_s_per_round", "cross_silo_evict_bar_s",
+    # live federation soak (ISSUE 15): train→publish→swap→serve under
+    # load with cross-tier kills — zero dropped requests, bounded lag
+    "live_loop_non2xx", "live_loop_requests", "live_loop_shed_429s",
+    "live_loop_round_to_serve_ms_p50", "live_loop_ttft_p99_ms",
+    "live_loop_fleet_lag_max", "live_loop_slo_ok",
     # Parrot-scale cohorts (ISSUE 8): chunked/streamed rounds + cost-LPT
     "sim_scale_hbm_headroom_ratio", "sim_scale_ingest_overhead_pct",
     "sim_scale_chunked_vs_unchunked_pct",
@@ -2058,6 +2126,8 @@ def main():
     acc.update(_retrying(bench_cross_silo_durability, quick, default=None) or
                {"cross_silo_durability_error":
                 "bench_cross_silo_durability failed twice"})
+    acc.update(_retrying(bench_live_loop, quick, default=None) or
+               {"live_loop_error": "bench_live_loop failed twice"})
     if not quick:
         # fresh-interpreter subprocess (forced-2-device jax cold start +
         # two engine compiles) — too heavy for the quick lane

@@ -303,6 +303,16 @@ def cmd_report(args) -> int:
         if raw and wire:
             print(f"wire codec: {raw / wire:.1f}x payload reduction "
                   f"({_fmt_bytes(raw)} raw -> {_fmt_bytes(wire)} wire)")
+        # live-loop soak (ISSUE 15): the closed-loop ledger — published
+        # training rounds vs the loadgen's status taxonomy
+        lg_req = counters.get("loadgen.requests", 0)
+        if lg_req:
+            print(f"live loop: {int(lg_req)} requests — "
+                  f"ok {int(counters.get('loadgen.ok', 0))}, "
+                  f"shed {int(counters.get('loadgen.shed', 0))}, "
+                  f"err {int(counters.get('loadgen.errors', 0))}; "
+                  f"{int(counters.get('soak.publishes', 0))} rounds "
+                  "published to serving")
         if counters:
             print("counters:")
             for k in sorted(counters):
@@ -545,6 +555,35 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
                     if p50 is not None:
                         seg += f"  {label}_p50<={p50 * 1e3:.2f}ms"
             lines.append(seg)
+
+    # ------------------------------------------------- live loop (ISSUE 15)
+    # train → publish → hot-swap → serve as ONE line: training round vs
+    # fleet version (the lag IS the loop's health), publish-to-serving
+    # latency, and the loadgen's SLO ledger (shed ≠ error)
+    if c.get("soak_publishes_total") or c.get("loadgen_requests_total"):
+        seg = (f"loop: round {int(g.get('soak_loop_round', 0))}"
+               f"  fleet_v {int(g.get('serving_fleet_version', 0))}"
+               f"  lag {int(g.get('soak_fleet_lag_rounds', 0))}"
+               f"  pub {int(c.get('soak_publishes_total', 0))}")
+        rs = h.get("soak_round_to_serve_s")
+        if rs and rs["count"]:
+            p50 = histogram_percentile(rs["buckets"], 0.5)
+            if p50 is not None:
+                seg += f"  pub2serve_p50<={p50 * 1e3:.0f}ms"
+        revived = int(c.get("soak_replica_revives_total", 0))
+        if revived:
+            seg += f"  revived {revived}"
+        seg += (f"  load ok {int(c.get('loadgen_ok_total', 0))}"
+                f" shed {int(c.get('loadgen_shed_total', 0))}"
+                f" err {int(c.get('loadgen_errors_total', 0))}")
+        tt = h.get("loadgen_ttft_s")
+        if tt and tt["count"]:
+            p99 = histogram_percentile(tt["buckets"], 0.99)
+            if p99 is not None:
+                seg += f"  ttft_p99<={p99 * 1e3:.0f}ms"
+        if "soak_slo_ok" in g:
+            seg += "  slo " + ("OK" if g["soak_slo_ok"] else "VIOLATED")
+        lines.append(seg)
 
     # ------------------------------------------------------------- retraces
     retr = {k: int(v) for k, v in c.items() if k.startswith("xla_retraces_")}
@@ -1354,6 +1393,70 @@ def cmd_diagnosis(args) -> int:
             b.stop()
             release_router(run)
 
+    def live_loop_smoke():
+        # the closed production loop end-to-end (ISSUE 15): a 3-round
+        # miniature live loop — 1 silo client federated-training LoRA
+        # adapters, 1 paged-engine replica serving them behind the
+        # gateway, loadgen at low rate, ONE trainer kill (the server is
+        # SIGKILL-severed after round 1 and resumes from checkpoint) —
+        # must complete with the fleet hot-swapped to the final round's
+        # version and ZERO non-2xx responses (shed 429s excluded),
+        # inside a ~20s budget.
+        import tempfile
+        import time as _t
+
+        from .comm.chaos import FaultSpec
+        from .soak.loadgen import TrafficSpec
+        from .soak.loop import LiveLoopHarness
+
+        t0 = _t.perf_counter()
+        with tempfile.TemporaryDirectory() as store, \
+                tempfile.TemporaryDirectory() as ckpt:
+            h = LiveLoopHarness(
+                rounds=3, n_clients=1, n_replicas=1, seed=0,
+                store_dir=store, checkpoint_dir=ckpt,
+                max_len=32, prefill_chunk=4,
+                fault_spec=FaultSpec(silo_kill={0: 1}),
+                traffic=TrafficSpec(
+                    seed=0, vocab=32, rate_rps=8.0, duration_s=20.0,
+                    stream_frac=0.3, prefix_len=6, suffix_len_max=8,
+                    out_len_max=6))
+            try:
+                # a 2s post-convergence traffic tail: the 3 training
+                # rounds finish fast, and the zero-non-2xx bar should
+                # cover steady-state serving too, not 3 requests
+                rep = h.run(timeout=60, tail_s=2.0)
+            finally:
+                h.close()
+        dt = _t.perf_counter() - t0
+        if rep["non2xx_excl_shed"]:
+            raise ValueError(
+                f"live loop dropped requests: {rep['non2xx_excl_shed']} "
+                f"non-2xx (codes {rep['error_codes']}) — shed 429s "
+                "excluded, so these are real failures")
+        if not rep["train_done"] or rep["train_error"]:
+            raise RuntimeError(
+                f"training did not complete: {rep['train_error']}")
+        if rep["fleet_version"] != 3 or not rep["converged"]:
+            raise ValueError(
+                f"fleet never reached the final round's adapters: "
+                f"fleet_version {rep['fleet_version']} (want 3), "
+                f"versions {rep['fleet_versions']}")
+        if len(rep["kills_executed"]) != 1:
+            raise ValueError(
+                f"trainer kill never fired: {rep['kills_executed']}")
+        if dt > 20:
+            raise RuntimeError(
+                f"live loop smoke took {dt:.1f}s (budget 20s) — the "
+                "probe is too slow for the diagnosis battery")
+        return {"rounds": rep["rounds_done"],
+                "requests": rep["requests"], "ok_requests": rep["ok"],
+                "shed_429s": rep["shed_429s"], "non_2xx": 0,
+                "fleet_version": rep["fleet_version"],
+                "lag_max": rep["lag_max_seen"],
+                "kills": rep["kills_executed"],
+                "elapsed_s": round(dt, 1)}
+
     probes = {"jax": jax_devices, "wire_codec": wire,
               "loopback_transport": loopback, "grpc_transport": grpc,
               "native_lib": native, "metrics_endpoint": metrics_endpoint,
@@ -1365,6 +1468,7 @@ def cmd_diagnosis(args) -> int:
               "partition_rules_smoke": partition_rules_smoke,
               "cohort_sharded_smoke": cohort_sharded_smoke,
               "cross_silo_durability_smoke": cross_silo_durability_smoke,
+              "live_loop_smoke": live_loop_smoke,
               "lint_clean": lint_clean}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "codec_smoke",
@@ -1372,7 +1476,8 @@ def cmd_diagnosis(args) -> int:
                 "serving_spec_smoke",
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
-                "cross_silo_durability_smoke", "lint_clean")
+                "cross_silo_durability_smoke", "live_loop_smoke",
+                "lint_clean")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)

@@ -26,6 +26,12 @@ against `make_policy` — every registered comm_codec knob must be read there,
 nothing unregistered may be — and config.py must validate comm_codec through
 `validate_comm_codec` instead of a hand-rolled key list. This leg anchors on
 comm/codec.py + config.py and stays dormant in scans that stage neither.
+
+And the live-loop soak plane (ISSUE 15): soak/knobs.py's `SOAK_KNOBS`
+registry (pure literal, consumer="plan") is cross-checked against
+`soak_plan` — the one function translating validated soak knobs into the
+loadgen/loop/slo kwargs — and config.py must validate the soak section
+through `validate_soak`. Anchors on soak/knobs.py + config.py.
 """
 from __future__ import annotations
 
@@ -80,6 +86,7 @@ class KnobDriftRule(Rule):
     def check(self, ctx: LintContext) -> Iterable[Finding]:
         yield from self._serve_leg(ctx)
         yield from self._codec_leg(ctx)
+        yield from self._soak_leg(ctx)
 
     def _serve_leg(self, ctx: LintContext) -> Iterable[Finding]:
         anchors = {a: ctx.get(a) for a in _ANCHORS}
@@ -107,7 +114,8 @@ class KnobDriftRule(Rule):
         config_f = ctx.get("config.py")
         if codec_f is None or config_f is None:
             return  # subset scan: codec plane not staged
-        registry = self._load_codec_registry(codec_f)
+        registry = self._load_literal_registry(
+            codec_f, "CODEC_KNOBS", "policy", "comm/codec.py CODEC_KNOBS")
         if isinstance(registry, Finding):
             yield registry
             return
@@ -133,44 +141,88 @@ class KnobDriftRule(Rule):
                 "comm/codec.py (`from .comm.codec import "
                 "validate_comm_codec`) — the validated key set can drift "
                 "from the policy consumer")
-        for node in ast.walk(config_f.tree):
-            if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
-                strs = {const_str(e) for e in node.elts} - {None}
-                hits = strs & set(registry)
-                if len(hits) >= 3:
-                    yield Finding(
-                        self.name, config_f.path, node.lineno,
-                        node.col_offset,
-                        f"literal key list holding {len(hits)} comm_codec "
-                        "registry knobs — a hand-synced copy of "
-                        "comm/codec.py CODEC_KNOBS that WILL drift; "
-                        "iterate the registry instead")
+        yield from self._check_hand_synced(
+            config_f, registry, "comm/codec.py CODEC_KNOBS")
 
-    def _load_codec_registry(self, f: SourceFile):
+    # -------------------------------------------------------- soak leg
+    def _soak_leg(self, ctx: LintContext) -> Iterable[Finding]:
+        soak_f = ctx.get("soak/knobs.py")
+        config_f = ctx.get("config.py")
+        if soak_f is None or config_f is None:
+            return  # subset scan: soak plane not staged
+        registry = self._load_literal_registry(
+            soak_f, "SOAK_KNOBS", "plan", "soak/knobs.py")
+        if isinstance(registry, Finding):
+            yield registry
+            return
+        yield from self._check_mapping(
+            soak_f, "soak_plan", set(registry), registry, "plan",
+            registry_label="soak/knobs.py SOAK_KNOBS")
+        # config.py must validate the soak section THROUGH the soak module
+        imports_soak = any(
+            isinstance(n, ast.ImportFrom) and n.module
+            and n.module.split(".")[-2:] == ["soak", "knobs"]
+            for n in ast.walk(config_f.tree))
+        calls_validator = any(
+            isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name)
+                 and n.func.id == "validate_soak")
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "validate_soak"))
+            for n in ast.walk(config_f.tree))
+        if not (imports_soak and calls_validator):
+            yield Finding(
+                self.name, config_f.path, 1, 0,
+                "config.py does not validate the soak section through "
+                "soak/knobs.py (`from .soak.knobs import validate_soak`) "
+                "— the validated key set can drift from the plan consumer")
+        yield from self._check_hand_synced(
+            config_f, registry, "soak/knobs.py SOAK_KNOBS")
+
+    def _load_literal_registry(self, f: SourceFile, var: str,
+                               consumer: str, label: str):
+        """Shared literal-registry loader for the codec and soak legs:
+        the assignment must literal_eval and every entry must carry the
+        leg's consumer tag."""
         for node in ast.walk(f.tree):
             if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "CODEC_KNOBS"
+                    isinstance(t, ast.Name) and t.id == var
                     for t in node.targets):
                 try:
                     reg = ast.literal_eval(node.value)
                 except (ValueError, SyntaxError):
                     return Finding(
                         self.name, f.path, node.lineno, node.col_offset,
-                        "CODEC_KNOBS must stay a pure literal — graftlint "
+                        f"{var} must stay a pure literal — graftlint "
                         "(and the import-free Docker build hook) reads it "
                         "with ast.literal_eval")
                 bad = [k for k, s in reg.items()
                        if not isinstance(s, dict)
-                       or s.get("consumer") != "policy"]
+                       or s.get("consumer") != consumer]
                 if bad:
                     return Finding(
                         self.name, f.path, node.lineno, node.col_offset,
-                        f"codec registry entries {sorted(bad)} missing the "
-                        "'policy' consumer tag — the drift check cannot "
-                        "assign them a mapping")
+                        f"registry entries {sorted(bad)} missing the "
+                        f"{consumer!r} consumer tag — the drift check "
+                        "cannot assign them a mapping")
                 return reg
         return Finding(self.name, f.path, 1, 0,
-                       "comm/codec.py defines no CODEC_KNOBS registry")
+                       f"{label.split()[0]} defines no {var} registry")
+
+    def _check_hand_synced(self, f: SourceFile, registry: dict,
+                           label: str) -> Iterable[Finding]:
+        """A literal collection holding 3+ registry keys is a resurrected
+        hand-synced copy of the key set."""
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+                strs = {const_str(e) for e in node.elts} - {None}
+                hits = strs & set(registry)
+                if len(hits) >= 3:
+                    yield Finding(
+                        self.name, f.path, node.lineno, node.col_offset,
+                        f"literal key list holding {len(hits)} registry "
+                        f"knobs — a hand-synced copy of {label} that "
+                        "WILL drift; iterate the registry instead")
 
     # ------------------------------------------------------------------
     def _load_registry(self, f: SourceFile):

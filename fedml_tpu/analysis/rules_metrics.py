@@ -1,7 +1,8 @@
 """metric-registry: metric-name consistency across emit and consume sites.
 
 The repo's observability contract is stringly typed: `utils/metrics.py`
-instruments by dotted name (`fed.*` / `serving.*` / `comm.*` / `xla.*`),
+instruments by dotted name (`fed.*` / `serving.*` / `comm.*` / `xla.*`,
+plus the live-loop soak's `soak.*` / `loadgen.*` — ISSUE 15),
 `utils/prometheus.py` sanitizes those to exposition names
 (`fed_rounds_total`), and the `top` verb + README document them back to
 operators. Nothing ties the three together — a typo'd emit or a renamed
@@ -36,10 +37,13 @@ from .core import (
     edit_distance,
 )
 
-_FAMILIES = ("fed", "serving", "comm", "xla")
-_RAW_RE = re.compile(r"^(?:fed|serving|comm|xla)\.[a-z0-9_.]*$")
-_SAN_RE = re.compile(r"^(?:fed|serving|comm|xla)_[a-z0-9_]+$")
-_DOC_RE = re.compile(r"`((?:fed|serving|comm|xla)\.[^`\s]+)`")
+_FAMILIES = ("fed", "serving", "comm", "xla", "soak", "loadgen")
+_RAW_RE = re.compile(
+    r"^(?:fed|serving|comm|xla|soak|loadgen)\.[a-z0-9_.]*$")
+_SAN_RE = re.compile(
+    r"^(?:fed|serving|comm|xla|soak|loadgen)_[a-z0-9_]+$")
+_DOC_RE = re.compile(
+    r"`((?:fed|serving|comm|xla|soak|loadgen)\.[^`\s]+)`")
 _INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 
 # method name -> instrument kind

@@ -208,6 +208,32 @@ class FaultSpec:
         after = self.replica_kill.get(rank)
         return after is not None and n_tokens >= after
 
+    def validate_tiers(self, silo_ranks=None, replica_ranks=None) -> None:
+        """Cross-tier schedule validation (ISSUE 15): ONE FaultSpec can
+        carry both the training-tier `silo_kill` (round-indexed) and the
+        serving-tier `replica_kill` (streamed-token-indexed) timelines —
+        the live-loop soak harness (soak/loop.py) consumes both from the
+        same spec. A schedule naming a rank that does not exist in the
+        topology it targets would silently never fire (the soak would
+        pass without its kill); refuse it up front instead. Pass the
+        known rank sets for whichever tier(s) the caller actually runs —
+        `None` skips that tier's check (a serving-only consumer cannot
+        know silo ranks, and vice versa)."""
+        if silo_ranks is not None:
+            unknown = sorted(set(self.silo_kill) - set(silo_ranks))
+            if unknown:
+                raise ValueError(
+                    f"chaos.silo_kill names unknown rank(s) {unknown}; "
+                    f"this federation has ranks "
+                    f"{sorted(silo_ranks)} (0 = server)")
+        if replica_ranks is not None:
+            unknown = sorted(set(self.replica_kill) - set(replica_ranks))
+            if unknown:
+                raise ValueError(
+                    f"chaos.replica_kill names unknown replica(s) "
+                    f"{unknown}; this fleet has replicas "
+                    f"{sorted(replica_ranks)}")
+
 
 class ChaosTransport(BaseTransport, Observer):
     """Fault-injecting wrapper over any BaseTransport.

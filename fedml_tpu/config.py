@@ -514,6 +514,18 @@ class Config:
             from .comm.reliable import RetryPolicy
 
             RetryPolicy.from_dict(cr)
+        # live-loop soak knobs (ISSUE 15): `common_args.extra.soak` is
+        # validated by its owning module against the SOAK_KNOBS registry
+        # (pure literal; graftlint's knob-drift soak leg cross-checks the
+        # soak_plan consumer) — unknown keys, bad kinds, and gated knobs
+        # without their prerequisite all fail HERE, at load. The import
+        # is jax-free by design (soak/__init__ is lazy, knobs.py is a
+        # literal table).
+        sk = self.common_args.extra.get("soak")
+        if sk is not None:
+            from .soak.knobs import validate_soak
+
+            validate_soak(sk)
         # wire codec plane (ISSUE 14): `comm_args.comm_codec` is validated
         # by its owning module against the CODEC_KNOBS registry (pure
         # literal, graftlint's knob-drift rule cross-checks the consumer) —

@@ -31,6 +31,7 @@ from ..comm import FedCommManager
 from ..comm.loopback import LoopbackTransport, release_router
 from ..config import TrainArgs
 from ..models import hub
+from ..utils import metrics as _mx
 from .client import FedClientManager
 from .server import FedServerManager
 from .trainer import SiloTrainer
@@ -56,7 +57,9 @@ class SiloSoakHarness:
                  run_id: Optional[str] = None,
                  server_kw: Optional[dict] = None,
                  client_kw: Optional[dict] = None,
-                 comm_codec: Optional[dict] = None):
+                 comm_codec: Optional[dict] = None,
+                 init_params=None, trainer_factory=None,
+                 train_args: Optional[TrainArgs] = None):
         self.n_clients = n_clients
         self.rounds = rounds
         self.checkpoint_dir = checkpoint_dir
@@ -68,14 +71,29 @@ class SiloSoakHarness:
         # and EF residuals die with the process; the next dense broadcast
         # re-anchors, stale delta frames in the mailbox are loud-dropped)
         self.comm_codec = comm_codec
-        self.model = hub.create("lr", 3)
-        self.targs = TrainArgs(
+        # live-loop override points (ISSUE 15): the federation the soak
+        # drives can be ANY (init_params, per-client trainer) pairing —
+        # the live loop trains the serving model's LoRA adapter tree here
+        # while this file's defaults keep the original lr federation for
+        # the durability soaks
+        self._trainer_factory = trainer_factory
+        self.targs = train_args or TrainArgs(
             epochs=2, batch_size=16, learning_rate=0.3,
             client_num_in_total=n_clients, client_num_per_round=n_clients,
             comm_round=rounds)
-        self.init_params = jax.tree.map(
-            np.asarray, hub.init_params(self.model, (8,),
-                                        jax.random.key(seed)))
+        if init_params is not None:
+            if trainer_factory is None:
+                raise ValueError(
+                    "SiloSoakHarness(init_params=...) requires "
+                    "trainer_factory — the default lr trainers would "
+                    "train a model those params do not fit")
+            self.model = None
+            self.init_params = init_params
+        else:
+            self.model = hub.create("lr", 3)
+            self.init_params = jax.tree.map(
+                np.asarray, hub.init_params(self.model, (8,),
+                                            jax.random.key(seed)))
         self.server: Optional[FedServerManager] = None
         self.clients: dict[int, FedClientManager] = {}
         self._dead = []          # killed managers, kept so threads can drain
@@ -90,6 +108,8 @@ class SiloSoakHarness:
         return FedCommManager(t, rank)
 
     def _trainer(self, cid: int) -> SiloTrainer:
+        if self._trainer_factory is not None:
+            return self._trainer_factory(cid)
         x, y = _client_data(cid)
         return SiloTrainer(self.model.apply, self.targs, x, y, seed=cid)
 
@@ -143,6 +163,10 @@ class SiloSoakHarness:
             srv._cancel_timer()
             if srv._liveness_timer is not None:
                 srv._liveness_timer.cancel()
+        # tier-distinguishing chaos accounting (ISSUE 15): training-tier
+        # process deaths ride fed.chaos.silo_kills, the serving tier's
+        # ride fed.chaos.replica_kills (inference_runner._chaos_tick)
+        _mx.inc("fed.chaos.silo_kills")
         self._dead.append(srv)
         self.server = None
 
@@ -153,6 +177,7 @@ class SiloSoakHarness:
         th = c.comm._thread
         if th is not None:
             th.join(timeout=10)
+        _mx.inc("fed.chaos.silo_kills")
         self._dead.append(c)
 
     # ------------------------------------------------------------- helpers
@@ -226,6 +251,10 @@ def chaos_kill_soak(spec, checkpoint_dir: str, n_clients: int = 2,
     """
     kills = dict(spec.silo_kill) if hasattr(spec, "silo_kill") \
         else dict(spec or {})
+    if hasattr(spec, "validate_tiers"):
+        # a schedule naming a rank outside this federation would silently
+        # never fire — refuse it before the run starts (ISSUE 15)
+        spec.validate_tiers(silo_ranks=range(n_clients + 1))
     h = SiloSoakHarness(
         n_clients=n_clients, rounds=rounds, checkpoint_dir=checkpoint_dir,
         seed=seed, comm_codec=comm_codec,

@@ -25,9 +25,12 @@ local-first over fedml_tpu's own scheduler agents:)
   chunk-by-chunk; a stream cut by replica death mid-response is
   transparently re-served from token 0 on a survivor for deterministic
   (greedy) requests — already-relayed tokens are deduped so the client's
-  total stream is byte-identical to an unkilled run — and surfaced as a
-  terminal error event for sampled requests (re-running them would
-  change the tokens; a half-stream must never look complete).
+  total stream is byte-identical to an unkilled run, and an unpinned
+  stream whose replay diverges (the survivor swapped mid-rolling-update)
+  is continued via a prompt+delivered-prefix re-issue instead of erroring
+  — and surfaced as a terminal error event for sampled requests
+  (re-running them would change the tokens; a half-stream must never
+  look complete).
 - Deployment.rolling_update(): the federated model-churn path — round-N
   LoRA adapters published through utils/artifacts.py are hot-swapped
   into each replica IN TURN via its /swap endpoint (no restart, no
@@ -91,10 +94,16 @@ class _StalePin(RuntimeError):
 class _ReplayDiverged(RuntimeError):
     """A greedy failover replay produced a DIFFERENT token inside the
     already-relayed prefix — the survivor serves other weights (e.g. a
-    rolling update swapped it between the cut and the retry). Splicing
-    its suffix after the first replica's prefix would hand the client a
-    cross-version stream presented as clean output; surfaced as a
-    terminal error instead. The survivor is healthy — never suspected."""
+    rolling update swapped it between the cut and the retry). The
+    survivor is healthy — never suspected. For an UNPINNED stream the
+    gateway recovers by re-issuing a CONTINUATION (prompt + the tokens
+    the client already has, remaining budget) — the same
+    prefix-from-old-weights/suffix-under-new semantics an in-place hot
+    swap already gives unpinned in-flight streams, so nothing is
+    fabricated. Splicing the diverged replay itself (a suffix continuing
+    the SURVIVOR's prefix, not the client's) would fabricate output, and
+    a PINNED stream's pin was the version guarantee — those surface a
+    terminal error."""
 
 
 def fleet_knobs(sv: dict) -> tuple[dict, dict]:
@@ -261,14 +270,28 @@ class Deployment:
         routing work unchanged."""
         dep = cls(None, {}, min_replicas=len(endpoints),
                   max_replicas=len(endpoints), **kwargs)
-        for i, ep in enumerate(endpoints):
+        for ep in endpoints:
+            dep.adopt_endpoint(ep)
+        return dep
+
+    def adopt_endpoint(self, endpoint: str) -> _Replica:
+        """Adopt ONE already-running replica into the pool mid-flight —
+        the live-loop harness's replica-revival path (soak/loop.py): a
+        chaos-killed replica's replacement runner is brought up out of
+        band and joins routing here. The caller is responsible for the
+        replica's model version (swap it to the fleet target BEFORE
+        adopting, or the next rolling update's post-walk sweep converges
+        it)."""
+        with self._lock:
+            i = len(self.replicas)
             rep = _Replica(f"adopted-{i}")
             rep.replica_id = f"adopted-{i}"
-            rep.endpoint = ep.rstrip("/")
+            rep.endpoint = endpoint.rstrip("/")
             rep.state = R_READY
-            dep.replicas.append(rep)
-        dep._publish_gauges()
-        return dep
+            self.replicas.append(rep)
+            self.max_replicas = max(self.max_replicas, len(self.replicas))
+        self._publish_gauges()
+        return rep
 
     # ------------------------------------------------------------ deploy
     def deploy(self, n_replicas: Optional[int] = None,
@@ -540,6 +563,27 @@ class Deployment:
                 self.mark_suspect(rep)
         return updated
 
+    def converge(self, store, name: str, version: int) -> bool:
+        """Idempotent convergence sweep: bring every READY replica AT OR
+        ABOVE `version` by re-driving the swap where needed — the tail of
+        rolling_update as a standalone verb, for replicas that joined the
+        pool OUT OF BAND after the last update walked (the live-loop
+        harness's revived replicas, soak/loop.py). Unlike rolling_update
+        it never bumps the fleet version and treats already-ahead
+        replicas as done, so calling it twice is harmless. Returns True
+        when every ready replica reports `version` or newer."""
+        from ..utils.artifacts import store_spec
+
+        body = json.dumps({"store": store_spec(store), "name": name,
+                           "version": int(version)}).encode()
+        ok = True
+        for rep in self.ready_replicas():
+            if rep.model_version is not None \
+                    and rep.model_version >= int(version):
+                continue
+            ok = self._converge_version(rep, (body, int(version))) and ok
+        return ok
+
     def replica_info(self, rep: _Replica) -> Optional[dict]:
         try:
             with urllib.request.urlopen(rep.endpoint + "/info",
@@ -800,10 +844,17 @@ class InferenceGateway:
 
         - DETERMINISTIC requests (greedy: no temperature) are re-served
           from token 0 on a survivor; tokens the client already received
-          are skipped AFTER verifying they match the replay (a survivor
-          swapped mid-rolling-update decodes different tokens — that
-          divergence surfaces as a terminal error, never a splice), so a
-          completed stream is byte-identical to an unkilled run.
+          are skipped AFTER verifying they match the replay, so a
+          completed stream is byte-identical to an unkilled run. When
+          the replay DIVERGES (the survivor swapped mid-rolling-update
+          and decodes different tokens), an UNPINNED stream is recovered
+          by a CONTINUATION re-issue — prompt + the delivered tokens,
+          remaining budget — which greedily continues the CLIENT's
+          prefix under the current fleet, the same semantics an
+          in-place hot swap gives unpinned in-flight streams
+          (serving.stream_continuations); a version-PINNED stream
+          surfaces the divergence as a terminal error instead (the pin
+          was the guarantee, and the replay itself is never spliced).
         - NON-REPLAYABLE requests (sampling — rerunning draws different
           tokens, seeded or not: the survivor's slot/seed schedule is
           the engine's, but a half-relayed stream spliced with a rerun
@@ -827,13 +878,28 @@ class InferenceGateway:
             handler._send(400, {"error": "temperature must be a number; "
                                          f"got {parsed.get('temperature')!r}"})
             return
-        relayed: list = []      # token values already relayed, in order
+        delivered: list = []    # token values the CLIENT has, in order
+        # client index where the CURRENT upstream request's token 0 lands
+        # (> 0 after a divergence-recovery continuation re-issue)
+        cur_start = 0
         headers_out = False
         last_409: Optional[tuple[int, dict]] = None
         stale: set = set()      # replicas that 409'd this request's pin
-        for attempt in range(tries):
-            if attempt:
-                time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+        attempts = 0
+        # a divergence-recovery continuation re-issue is FREE: it is not
+        # a failed placement (the survivor is healthy and about to serve)
+        # so it must neither consume the retry budget nor pay a backoff —
+        # otherwise the canonical cut+skew recovery always lands on the
+        # last attempt with nothing left for a second fault
+        cont_dispatch = False
+        while True:
+            if not cont_dispatch:
+                if attempts >= tries:
+                    break
+                attempts += 1
+                if attempts > 1:
+                    time.sleep(self.retry_backoff_s * (2 ** (attempts - 2)))
+            cont_dispatch = False
             rep = self.dep.acquire(exclude=stale)
             if rep is None:
                 break
@@ -844,26 +910,40 @@ class InferenceGateway:
                 with urllib.request.urlopen(req, timeout=120) as r:
                     for ev in self._sse_events(r):
                         if "token" in ev:
-                            idx = int(ev.get("index", len(relayed)))
-                            if idx < len(relayed):
+                            # indices are the UPSTREAM request's frame;
+                            # delivered[cur_start:] is that frame's
+                            # already-relayed prefix
+                            local = len(delivered) - cur_start
+                            idx = int(ev.get("index", local))
+                            if idx < local:
                                 # replayed prefix: dedupe — but VERIFY it
                                 # matches what the client already has (a
                                 # survivor swapped mid-rolling-update
-                                # decodes different tokens; splicing
-                                # would fabricate a cross-version stream)
-                                if ev.get("token") != relayed[idx]:
+                                # decodes different tokens; splicing the
+                                # replay itself would fabricate a
+                                # cross-version stream)
+                                if ev.get("token") != delivered[
+                                        cur_start + idx]:
                                     raise _ReplayDiverged(
                                         f"token {idx} differs on replay")
                                 continue
                             if not headers_out:
                                 self._open_sse(handler)
                                 headers_out = True
+                            if cur_start:
+                                ev = {**ev, "index": cur_start + idx}
                             self._relay(handler, ev)
-                            relayed.append(ev.get("token"))
+                            delivered.append(ev.get("token"))
                         elif ev.get("done"):
                             if not headers_out:
                                 self._open_sse(handler)
                                 headers_out = True
+                            if cur_start and "generated_tokens" in ev:
+                                # a continuation's done event only knows
+                                # its own suffix; the client's stream is
+                                # the whole delivered sequence
+                                ev = {**ev,
+                                      "generated_tokens": list(delivered)}
                             self._relay(handler, ev)
                             return
                         elif "error" in ev:
@@ -887,12 +967,44 @@ class InferenceGateway:
                 _mx.inc("serving.client_disconnects")
                 return
             except _ReplayDiverged as e:
-                # the survivor is HEALTHY, its output just can't be
-                # spliced after the dead replica's prefix — clean terminal
-                # error, no suspect, no further retries
+                # the survivor is HEALTHY and serves a different model
+                # version than the one that produced the client's prefix
+                # (a rolling update landed between the cut and the
+                # replay) — never suspected either way
+                _mx.inc("serving.stream_replay_divergences")
+                cont = self._continuation_body(parsed, delivered)
+                if cont is not None:
+                    # UNPINNED greedy stream: continue the CLIENT's
+                    # prefix under the current fleet — re-issue with
+                    # prompt + delivered tokens and the remaining
+                    # budget. This is exactly what an in-place hot swap
+                    # mid-stream already gives unpinned streams (prefix
+                    # from the old weights, greedy suffix under the
+                    # new), so nothing is fabricated. ISSUE 15's soak
+                    # bar (zero non-2xx through kills DURING rolling
+                    # updates) rides this path.
+                    log.warning(
+                        "stream failover replay diverged via %s (%s); "
+                        "continuing the delivered prefix under the "
+                        "current fleet", rep.replica_id, e)
+                    _mx.inc("serving.stream_continuations")
+                    body, done_ev = cont
+                    if body is None:
+                        # budget already fully delivered — only the
+                        # terminal event was lost with the dead replica
+                        try:
+                            self._relay(handler, done_ev)
+                        except (_ClientGone, OSError):
+                            pass
+                        return
+                    cur_start = len(delivered)
+                    cont_dispatch = True
+                    continue
+                # PINNED (the pin WAS the version guarantee) or a body
+                # without tokens/budget to rebuild from: clean terminal
+                # error, no further retries
                 log.warning("stream failover replay diverged via %s: %s",
                             rep.replica_id, e)
-                _mx.inc("serving.stream_replay_divergences")
                 try:
                     if headers_out:
                         self._relay(handler, {
@@ -945,12 +1057,12 @@ class InferenceGateway:
                     ConnectionError, json.JSONDecodeError) as e:
                 log.warning("stream via %s cut: %s; %s", rep.replica_id, e,
                             "re-serving on a survivor"
-                            if greedy or not (headers_out or relayed)
+                            if greedy or not (headers_out or delivered)
                             else "surfacing")
                 _mx.inc("serving.gateway_failovers")
                 _mx.inc("serving.stream_failovers")
                 self.dep.mark_suspect(rep)
-                if not greedy and (headers_out or relayed):
+                if not greedy and (headers_out or delivered):
                     # non-replayable AND bytes already reached the
                     # client: clean failure, never a fake done. A
                     # sampled stream cut BEFORE its first byte retries
@@ -987,6 +1099,29 @@ class InferenceGateway:
                 handler._send(code, payload)
         except (_ClientGone, OSError):
             pass
+
+    @staticmethod
+    def _continuation_body(parsed, delivered):
+        """Divergence recovery for an UNPINNED stream: (new request
+        body, None) to re-issue — prompt grown by the tokens the client
+        already has, budget shrunk to the remainder — or (None, done
+        event) when the budget was already fully delivered and only the
+        terminal event was lost, or None when the stream cannot be
+        continued (version-pinned, or no tokens/max_new_tokens fields
+        to rebuild from)."""
+        toks = parsed.get("tokens")
+        mn = parsed.get("max_new_tokens")
+        if parsed.get("model_version") is not None \
+                or not isinstance(toks, list) \
+                or not isinstance(mn, int) or isinstance(mn, bool):
+            return None
+        remaining = mn - len(delivered)
+        if remaining <= 0:
+            return None, {"done": True,
+                          "generated_tokens": list(delivered)}
+        return json.dumps({**parsed,
+                           "tokens": list(toks) + list(delivered),
+                           "max_new_tokens": remaining}).encode(), None
 
     @staticmethod
     def _open_sse(handler) -> None:

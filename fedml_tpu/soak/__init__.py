@@ -1,0 +1,58 @@
+"""Live federation soak (ISSUE 15) — the closed production loop:
+train → publish → hot-swap → serve under million-user-shaped traffic,
+with cross-tier chaos.
+
+PRs 9–10 built both halves — a durable cross-silo trainer that survives
+SIGKILL and a serving fleet with hot LoRA swap, shedding, and mid-stream
+failover — but nothing ever ran them as ONE system. This package is the
+integration layer:
+
+- `loadgen.py` — a seeded, replayable traffic generator shaping the
+  millions-of-users request stream: Zipf-distributed shared prompt
+  prefixes (exercises the serving tier's prefix cache), heavy-tailed
+  prompt/output lengths, open-loop arrival with scheduled bursts above
+  the shed watermark, unary + SSE-streaming requests, per-request SLO
+  bookkeeping (TTFT/TBT/total; shed 429s counted separately from
+  failures).
+- `loop.py` — `LiveLoopHarness`: a durable cross-silo federation trains
+  the serving model's LoRA adapters and publishes each round's aggregate
+  to the artifact store; a watcher drives `Deployment.rolling_update` so
+  the fleet hot-swaps every round while loadgen traffic flows; ONE
+  `FaultSpec` timeline SIGKILLs trainers (round-indexed `silo_kill`) and
+  serving replicas (token-indexed `replica_kill`) on schedule.
+- `slo.py` — windowed SLO evaluation: TTFT p99, rounds/s, non-2xx count
+  (bounded 429 sheds excluded), and fleet_version-vs-training-round lag,
+  rendered as the `loop:` line in `fedml_tpu top`, a `report` summary,
+  and the `live_loop_*` bench rows.
+- `knobs.py` — the pure-literal `SOAK_KNOBS` registry config.py
+  validates `common_args.extra.soak` against (graftlint's knob-drift
+  rule cross-checks the `soak_plan` consumer).
+
+Lazy re-exports (PEP 562): `knobs` must stay importable without jax
+(config.py reads it at load time); the harness modules import jax on
+first symbol access.
+"""
+from __future__ import annotations
+
+import importlib
+
+__all__ = [
+    "SOAK_KNOBS", "validate_soak", "soak_plan",
+    "TrafficSpec", "LoadGenerator", "build_schedule",
+    "LiveLoopHarness", "evaluate_slo",
+]
+
+_LAZY = {
+    "SOAK_KNOBS": "knobs", "validate_soak": "knobs", "soak_plan": "knobs",
+    "TrafficSpec": "loadgen", "LoadGenerator": "loadgen",
+    "build_schedule": "loadgen",
+    "LiveLoopHarness": "loop",
+    "evaluate_slo": "slo",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

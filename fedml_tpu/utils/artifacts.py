@@ -19,8 +19,12 @@ Artifacts are pytrees of arrays; `get` returns numpy-backed trees.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import re
 import threading
+import time
 from pathlib import Path
 from typing import Any, Optional
 
@@ -82,7 +86,26 @@ def store_from_spec(spec: dict):
 
 
 class FileArtifactStore:
-    """Directory-backed store: one codec blob per artifact name."""
+    """Directory-backed store: one codec blob per artifact name, plus a
+    meta sidecar written LAST (ISSUE 15).
+
+    Publish protocol — tensors first, meta last, both via fsync'd
+    temp-file + `os.replace`: a serving fleet rolling an update while the
+    trainer is mid-publish can never observe a half-written adapter. The
+    `os.replace` makes each file atomically either the old or the new
+    version; the fsync makes a crash-interrupted publish leave either
+    nothing new or a complete blob; and the meta sidecar (byte count +
+    blake2b digest of the tensor blob, replaced only AFTER the tensors
+    landed) is the reader's publish barrier: `get` verifies the blob
+    against it and, in the one racy window where the new tensors have
+    landed but the new meta has not, retries until the meta catches up —
+    so a reader racing a slow publish returns the complete NEW artifact,
+    never a torn pairing (pinned in tests/test_live_loop.py)."""
+
+    # how long `get` waits out a publisher that has replaced the tensors
+    # but not yet the meta (the file ops in between are microseconds; the
+    # budget only has to cover scheduler noise)
+    _META_RACE_BUDGET_S = 2.0
 
     def __init__(self, root: str):
         self.root = Path(root)
@@ -91,19 +114,62 @@ class FileArtifactStore:
     def _path(self, name: str) -> Path:
         return self.root / (_check_name(name) + ".bin")
 
+    def _meta_path(self, name: str) -> Path:
+        return self.root / (_check_name(name) + ".meta")
+
+    @staticmethod
+    def _digest(blob: bytes) -> str:
+        return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+    @staticmethod
+    def _write_atomic(path: Path, blob: bytes) -> None:
+        """fsync'd temp-file + os.replace: `path` is atomically either
+        absent/old or the complete new content, even across a crash."""
+        tmp = path.with_name(path.name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
     def put(self, name: str, tree: Pytree) -> str:
         p = self._path(name)
         p.parent.mkdir(parents=True, exist_ok=True)
-        tmp = p.with_suffix(".tmp")
-        tmp.write_bytes(encode(tree))
-        tmp.replace(p)          # atomic: a concurrent reader never sees a
-        return str(p)           # half-written artifact
+        blob = encode(tree)
+        self._write_atomic(p, blob)                      # tensors FIRST
+        self._write_atomic(self._meta_path(name), json.dumps(
+            {"bytes": len(blob),
+             "digest": self._digest(blob)}).encode())    # meta LAST
+        return str(p)
 
     def get(self, name: str) -> Pytree:
         p = self._path(name)
-        if not p.exists():
-            raise KeyError(f"no artifact {name!r} under {self.root}")
-        return decode(p.read_bytes())
+        mp = self._meta_path(name)
+        deadline = time.monotonic() + self._META_RACE_BUDGET_S
+        while True:
+            if not p.exists():
+                raise KeyError(f"no artifact {name!r} under {self.root}")
+            blob = p.read_bytes()
+            try:
+                meta = json.loads(mp.read_bytes())
+            except (OSError, json.JSONDecodeError):
+                # pre-meta layout (a store written by an older build):
+                # the blob itself is complete — os.replace was always
+                # atomic — so serve it as-is
+                return decode(blob)
+            if (meta.get("bytes") == len(blob)
+                    and meta.get("digest") == self._digest(blob)):
+                return decode(blob)
+            # tensors/meta disagree: we are inside a concurrent publish
+            # (new tensors landed, meta still the old artifact's) — wait
+            # for the publisher's meta-last write instead of handing the
+            # caller a torn pairing
+            if time.monotonic() >= deadline:
+                raise ValueError(
+                    f"artifact {name!r} tensors do not match their meta "
+                    f"after {self._META_RACE_BUDGET_S}s — torn publish "
+                    "(publisher died between tensor and meta replace?)")
+            time.sleep(0.005)
 
     def list(self) -> list[str]:
         return sorted(
@@ -112,6 +178,7 @@ class FileArtifactStore:
 
     def delete(self, name: str) -> None:
         self._path(name).unlink(missing_ok=True)
+        self._meta_path(name).unlink(missing_ok=True)
 
 
 class BrokerArtifactStore:

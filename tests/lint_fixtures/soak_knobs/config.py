@@ -1,0 +1,12 @@
+"""Fixture: config that hand-syncs the soak key set instead of using the
+registry validator."""
+
+_SOAK_KEYS = ("rounds", "rate_rps", "zipf_s")   # FINDING: hand-synced copy
+
+
+def validate(cfg):
+    sk = cfg.get("soak")
+    if sk:
+        for k in sk:
+            if k not in _SOAK_KEYS:      # resurrection of the key list
+                raise ValueError(k)

@@ -1,0 +1,20 @@
+"""Fixture: drifted soak registry (knob-drift soak leg)."""
+
+SOAK_KNOBS = {
+    "rounds":   {"kind": "int", "min": 1, "consumer": "plan"},
+    "rate_rps": {"kind": "num", "strict": True, "consumer": "plan"},
+    "zipf_s":   {"kind": "num", "strict": True, "consumer": "plan"},  # FINDING: never read
+}
+
+
+def validate_soak(extra):
+    for k in extra:
+        if k not in SOAK_KNOBS:
+            raise ValueError(k)
+
+
+def soak_plan(sk):
+    rounds = sk.get("rounds")
+    rate = sk.get("rate_rps")
+    rogue = sk.get("surge_rps")          # FINDING: not registered
+    return (rounds, rate, rogue)

@@ -153,6 +153,24 @@ def test_knob_drift_codec_leg_fixture():
     assert any("hand-synced copy" in m and "CODEC_KNOBS" in m for m in msgs)
 
 
+def test_knob_drift_soak_leg_fixture():
+    """The live-loop soak half of knob-drift (ISSUE 15): a registered
+    soak knob `soak_plan` never reads, an unregistered knob it does
+    read, a config that bypasses validate_soak, and a resurrected
+    hand-synced key list all surface. The real tree's soak plane passes
+    via the zero-findings gate."""
+    findings, _stats = _lint_fixture("soak_knobs", "knob-drift")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 4, msgs
+    assert any("knob `zipf_s`" in m and "validated-then-dropped" in m
+               and "soak/knobs.py SOAK_KNOBS" in m for m in msgs)
+    assert any("knob `surge_rps`" in m and "does not register" in m
+               for m in msgs)
+    assert any("does not validate the soak section through soak/knobs.py"
+               in m for m in msgs)
+    assert any("hand-synced copy" in m and "SOAK_KNOBS" in m for m in msgs)
+
+
 def test_knob_drift_suppressed_and_clean():
     findings, stats = _lint_fixture("knobs_suppressed", "knob-drift")
     assert findings == [] and stats["suppressed"] == 5

@@ -549,6 +549,83 @@ def test_midstream_chaos_kill_greedy_reserved_seeded_errors(setup, want):
         survivor.stop()
 
 
+def test_midstream_kill_during_version_skew_continues_stream(setup, want):
+    """The ISSUE 15 live-loop race, pinned deterministically: a replica
+    dies mid-stream while the only survivor already serves a NEWER
+    adapter version (mid-rolling-update skew). The greedy replay
+    diverges inside the delivered prefix; an UNPINNED stream must then
+    be CONTINUED — prompt + delivered tokens re-issued under the new
+    weights — so the client gets prefix-under-v1 + greedy
+    continuation-under-v2 with a real `done`, exactly what an in-place
+    hot swap mid-stream would have produced. Zero non-2xx through
+    version churn rides this path."""
+    from fedml_tpu.comm.chaos import FaultSpec
+    from fedml_tpu.utils import metrics as _mx
+
+    model, params, a1, a2 = setup
+    p1, p2 = want
+    prompt = _prompt()
+    want1 = p1.predict({"tokens": prompt, "max_new_tokens": 12}
+                       )["generated_tokens"]
+    want2 = p2.predict({"tokens": prompt, "max_new_tokens": 12}
+                       )["generated_tokens"]
+    # precondition: the versions must disagree inside the kill window,
+    # or the replay would simply dedupe (that path is the test above)
+    assert want1[:4] != want2[:4], "fixture adapters too similar"
+
+    doomed = FedMLInferenceRunner(
+        GreedyLMPredictor(model, params, adapters=a1, max_len=MAXLEN,
+                          kv_cache=True, decode_slots=2),
+        port=0, chaos=FaultSpec(replica_kill={0: 4}), chaos_rank=0).start()
+    survivor = FedMLInferenceRunner(
+        GreedyLMPredictor(model, params, adapters=a2, max_len=MAXLEN,
+                          kv_cache=True, decode_slots=2), port=0).start()
+    dep = Deployment.adopt(
+        [f"http://127.0.0.1:{doomed.port}",
+         f"http://127.0.0.1:{survivor.port}"], probation_deadline_s=0.5)
+    gw = InferenceGateway(dep, scale_interval=30, retry_backoff_s=0.01)
+    gw.start()
+    url = f"http://127.0.0.1:{gw.port}/predict"
+    try:
+        cut_toks = cut_events = None
+        for _ in range(6):
+            _ctype, events = _sse(url, {"tokens": prompt,
+                                        "max_new_tokens": 12,
+                                        "stream": True})
+            toks = [e["token"] for e in events if "token" in e]
+            assert events[-1].get("done") is True, events[-1]
+            assert len(toks) == 12
+            if _mx.snapshot()["counters"].get(
+                    "serving.stream_continuations"):
+                cut_toks, cut_events = toks, events
+                break
+            # an uncut stream is wholly v1 (doomed) or wholly v2
+            assert toks in (want1, want2)
+        assert cut_toks is not None, "replica_kill never fired mid-stream"
+        # prefix: what the dead replica delivered under a1
+        assert cut_toks[:4] == want1[:4]
+        # suffix: the survivor's greedy CONTINUATION of the client's
+        # prefix under a2 — not the survivor's own from-scratch decode
+        want_suffix = p2.predict(
+            {"tokens": prompt + cut_toks[:4], "max_new_tokens": 8}
+        )["generated_tokens"]
+        assert cut_toks[4:] == want_suffix
+        assert cut_toks != want1 and cut_toks != want2
+        # client-facing indices stay contiguous across the re-issue and
+        # the done event carries the WHOLE delivered stream
+        idxs = [e["index"] for e in cut_events if "token" in e]
+        assert idxs == list(range(12))
+        done_ev = [e for e in cut_events if e.get("done")][-1]
+        assert done_ev["generated_tokens"] == cut_toks
+        snap = _mx.snapshot()["counters"]
+        assert snap.get("serving.stream_replay_divergences") == 1
+        assert snap.get("serving.stream_continuations") == 1
+    finally:
+        gw.stop()
+        doomed.stop()
+        survivor.stop()
+
+
 # ----------------------------------------------------------- satellites
 def test_chaos_replica_kill_spec():
     from fedml_tpu.comm.chaos import FaultSpec

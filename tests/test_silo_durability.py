@@ -114,10 +114,12 @@ def test_dead_client_evicted_then_recovered_rejoins(tmp_path):
     more round_timeout stalls on its account); once it comes back, its
     first status re-enters it into the pool."""
     # round_timeout must cover the rejoined client's cold jit compile
-    # (~1s) or its first post-recovery round is timeout-dropped and the
-    # re-selection assertion races the end of the run
+    # (~1s) or its first post-recovery round is timeout-dropped; 8 rounds
+    # (not 5) so recovery on a loaded box still has rounds LEFT to be
+    # re-selected into (warm 2-client rounds close in ~0.1s — all five
+    # used to finish before a slow cold start even announced)
     h = SiloSoakHarness(
-        n_clients=3, rounds=5,
+        n_clients=3, rounds=8,
         server_kw=dict(round_timeout=1.5, quorum_frac=0.5,
                        liveness_timeout_s=0.9))
     try:
@@ -142,11 +144,22 @@ def test_dead_client_evicted_then_recovered_rejoins(tmp_path):
         assert h.server.client_online.get(3) is True, "client 3 never rejoined"
         assert mx.snapshot()["counters"]["fed.server.rejoins"] \
             >= rejoins_before + 1
+        # deterministic core: the selection pool itself re-includes the
+        # recovered client (independent of how many rounds remain)
+        round_at_recovery = h.server.round_idx
+        assert 3 in h.server._select_clients(round_at_recovery + 1), \
+            "recovered client missing from the selection pool"
         assert h.wait_done(timeout=60)
-        # m == total, so once back in the pool it is selected again: some
-        # post-recovery round must have counted all 3 results
-        assert any(r["n_received"] == 3 for r in h.server.history[2:]), \
-            f"recovered client never re-selected: {h.server.history}"
+        # end-to-end: every round selected AFTER recovery drafts all 3 —
+        # conditional on such a round existing (on a loaded box the run
+        # can complete before a slow recovery; the pool assertion above
+        # is the invariant either way, and with 8 rounds the window is
+        # wide enough that this leg exercises in practice)
+        post = [r for r in h.server.history
+                if r["round"] > round_at_recovery]
+        if post:
+            assert any(r["n_received"] == 3 for r in post), \
+                f"recovered client never re-selected: {h.server.history}"
     finally:
         h.close()
 
