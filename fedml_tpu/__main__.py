@@ -515,6 +515,14 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
             fo = int(c.get("serving_stream_failovers_total", 0))
             if fo:
                 seg += f"  stream_failovers {fo}"
+            # prefix-affinity routing (ISSUE 16): share of requests
+            # whose first placement landed on a replica already holding
+            # their prefix page — the fleet-wide cache-locality signal
+            ah = int(c.get("serving_affinity_hits_total", 0))
+            am = int(c.get("serving_affinity_misses_total", 0))
+            af = int(c.get("serving_affinity_fallbacks_total", 0))
+            if ah + am + af:
+                seg += f"  affinity {ah / (ah + am + af) * 100:.0f}%"
             st = h.get("serving_stream_ttft")
             if st and st["count"]:
                 p50 = histogram_percentile(st["buckets"], 0.5)
@@ -1112,6 +1120,78 @@ def cmd_diagnosis(args) -> int:
                 "accept_rate": round(accepted / max(proposed, 1), 3),
                 "programs": counts}
 
+    def serving_density_smoke():
+        # the serving-density plane end-to-end (ISSUE 16): the same
+        # prompts through (1) the baseline paged engine, (2) int8 KV
+        # pages, (3) int8 + batched admission — greedy outputs must
+        # match the baseline at >= 0.99 token rate (here: exactly,
+        # the tiny model has wide logit margins), the
+        # serving.kv_bytes_per_slot gauge must show >= 2x density
+        # (int8 pool + f32 per-page-per-head scales vs the baseline
+        # pool at the same slot/page geometry), and batched admission
+        # must have compiled a bounded set of batch programs while
+        # recording its serving.engine.admit_batch histogram.
+        import jax as _jax
+        import jax.numpy as _jnp
+        import numpy as _np
+
+        from .llm.transformer import TransformerLM
+        from .serving.engine import DecodeEngine
+        from .utils import metrics as mx
+
+        model = TransformerLM(vocab_size=64, d_model=32, n_layers=1,
+                              n_heads=2, d_ff=64, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        rs = _np.random.RandomState(0)
+        # all length 8 = exactly two 4-token chunks: one chunk program
+        # on the unbatched engines, one batch bucket on the batched one
+        prompts = [rs.randint(1, 64, 8).tolist() for _ in range(4)]
+
+        def run(**kw):
+            eng = DecodeEngine(model, params, n_slots=4, max_len=32,
+                               page_size=4, prefill_chunk=4, **kw).start()
+            try:
+                tickets = [eng.submit(p, 6) for p in prompts]
+                outs = [t.result(timeout=60) for t in tickets]
+                bps = mx.snapshot()["gauges"]["serving.kv_bytes_per_slot"]
+                return outs, eng.program_counts(), int(bps)
+            finally:
+                eng.stop()
+
+        base, _c, bps_base = run()
+        h0 = mx.snapshot()["histograms"].get(
+            "serving.engine.admit_batch", {}).get("count", 0)
+        quant, _c, bps_q = run(kv_quant="int8")
+        batched, counts, _bps = run(kv_quant="int8", admit_batch=4)
+        h1 = mx.snapshot()["histograms"].get(
+            "serving.engine.admit_batch", {}).get("count", 0)
+        total = sum(len(o) for o in base)
+        matched = sum(a == b for ob, oq in zip(base, quant)
+                      for a, b in zip(ob, oq))
+        if matched / total < 0.99:
+            raise ValueError(
+                f"int8 KV pages diverged from the baseline: "
+                f"{matched}/{total} greedy tokens matched (bar 0.99)")
+        if batched != quant:
+            raise ValueError(
+                "batched admission changed int8 outputs — admission "
+                "grouping must be invisible to decoded tokens")
+        if bps_q * 2 > bps_base:
+            raise ValueError(
+                f"int8 pool density below 2x: {bps_q} bytes/slot vs "
+                f"baseline {bps_base}")
+        nb = counts.get("admit_batch")
+        if not nb or nb > 3:
+            raise ValueError(f"batch programs unbounded or absent: {counts}")
+        if h1 <= h0:
+            raise ValueError("serving.engine.admit_batch never recorded")
+        return {"requests": len(prompts),
+                "match_rate": round(matched / total, 4),
+                "kv_bytes_per_slot": {"base": bps_base, "int8": bps_q},
+                "density_x": round(bps_base / bps_q, 2),
+                "admit_batches": int(h1 - h0), "programs": counts}
+
     def fleet_rolling_update_smoke():
         # the serving-fleet robustness plane end-to-end (ISSUE 9): a
         # 2-replica engine-backed LM deployment under sustained
@@ -1464,6 +1544,7 @@ def cmd_diagnosis(args) -> int:
               "serving_engine_smoke": serving_engine_smoke,
               "serving_paged_smoke": serving_paged_smoke,
               "serving_spec_smoke": serving_spec_smoke,
+              "serving_density_smoke": serving_density_smoke,
               "fleet_rolling_update_smoke": fleet_rolling_update_smoke,
               "partition_rules_smoke": partition_rules_smoke,
               "cohort_sharded_smoke": cohort_sharded_smoke,
@@ -1473,7 +1554,7 @@ def cmd_diagnosis(args) -> int:
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "codec_smoke",
                 "serving_engine_smoke", "serving_paged_smoke",
-                "serving_spec_smoke",
+                "serving_spec_smoke", "serving_density_smoke",
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
                 "cross_silo_durability_smoke", "live_loop_smoke",

@@ -231,9 +231,61 @@ def make_kv_decode(n_heads: int, alpha: float = 16.0,
     return prefill, step
 
 
+def _kv_quant_write(pool, scales, wpage, woff, vals):
+    """Quantize-at-write for the int8 KV pool: symmetric per-(page, head)
+    scales that only GROW within one page tenancy (running max). pool
+    [P, ps, H, Dh] int8, scales [P, H] f32, wpage/woff [...] page/offset
+    indices, vals [..., H, Dh] new K or V rows in the compute dtype.
+
+    Four scatters, sound under append-only pages and duplicate page
+    indices within one call:
+      0. a write at offset 0 BEGINS a page (slot positions are monotone
+         and page-aligned, so offset 0 is written exactly when a page is
+         freshly claimed — including a post-rollback rewrite, whose old
+         rows were rejected speculation): scatter-min the previous
+         tenant's scale to 0 first. Without this, scales would only ever
+         grow across a server's lifetime — one outlier from a
+         long-retired request would pin a reused page's resolution
+         forever, and decoded tokens would depend on page-allocation
+         history (batched vs serial admission allocate in different
+         orders and must stay token-identical);
+      1. scatter-max each written row's |max|/127 into the touched pages'
+         scales — duplicates fold associatively;
+      2. requantize the RESIDENT rows of every touched page by
+         s_old/s_new — the factor is exactly 1.0 when the scale did not
+         grow, so round() is the identity and repeated writes to a page
+         cost no accumulated error (rounding loss happens only the
+         bounded number of times a page's running max actually
+         increases); duplicate page indices write byte-identical values,
+         so scatter order cannot matter (a freshly-reset page's factor
+         is 0 — its stale resident rows are zeroed, and rows past the
+         written range are read-masked anyway);
+      3. quantize the new rows with the grown scale at their unique
+         (page, offset) cells.
+    Writes redirected to the null page 0 churn its scale with garbage —
+    reads of page 0 only surface at masked-off positions, so that is
+    inert by the same contract that makes the redirect safe."""
+    f = vals.astype(jnp.float32)
+    cand = jnp.max(jnp.abs(f), axis=-1) / 127.0            # [..., H]
+    fresh = jnp.where((woff == 0)[..., None], 0.0, jnp.inf)
+    scales = scales.at[wpage].min(fresh)
+    s_new = scales.at[wpage].max(cand)
+    so, sn = scales[wpage], s_new[wpage]                   # [..., H]
+    snd = jnp.where(sn > 0, sn, 1.0)
+    factor = jnp.where(sn > 0, so / snd, 1.0)
+    resident = pool[wpage].astype(jnp.float32)             # [..., ps, H, Dh]
+    requant = jnp.clip(jnp.round(resident * factor[..., None, :, None]),
+                       -127, 127).astype(jnp.int8)
+    pool = pool.at[wpage].set(requant)
+    q = jnp.clip(jnp.round(f / snd[..., None]), -127, 127).astype(jnp.int8)
+    pool = pool.at[wpage, woff].set(q)
+    return pool, s_new
+
+
 def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
                          dtype=jnp.float32, eps: float = 1e-6,
-                         kernel: bool = False, mesh=None):
+                         kernel: bool = False, mesh=None,
+                         quant: bool = False):
     """Paged variant of make_kv_decode for the block-allocated engine
     cache (serving/engine.py): K/V live in a POOL of fixed-size pages
     `[L, n_pages, page_size, H, Dh]` instead of one contiguous
@@ -243,7 +295,7 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
     engine's HBM proportional to LIVE tokens (and lets identical prompt
     prefixes share physical pages) rather than `slots x max_len`.
 
-    Returns (chunk, step, verify):
+    Returns (chunk, step, verify, chunk_batch):
 
     chunk(params, adapters, cache, pages_row, tokens, t0, length)
         -> (cache, logits)     # ONE slot: process `length` prompt tokens
@@ -282,6 +334,30 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
                                # slot's page-table reservation redirect
                                # to the null page; step IS verify at
                                # C == 1.
+    chunk_batch(params, adapters, cache, pages, tokens, t0, lengths)
+        -> (cache, logits)     # BATCHED admission prefill: B same-bucket
+                               # requests' chunks through ONE program
+                               # (engine admit_batch > 1). tokens [B, C]
+                               # right-padded per row, pages [B,
+                               # max_pages], t0/lengths [B]; logits
+                               # [B, V] at each row's t0 + length - 1 —
+                               # exactly chunk's last-position logits.
+                               # length 0 marks a PAD row: every write
+                               # redirects to the null page and its
+                               # logits row is garbage the caller
+                               # discards. Keeps the gather path like
+                               # chunk — prefill cost amortizes over the
+                               # prompt; the fused kernel stays the
+                               # decode-side hot path.
+
+    `quant=True` stores the pool in int8 with per-(page, head) f32
+    scales riding as extra cache leaves {"ks", "vs"} [L, P, H]:
+    quantize-at-write with running-max scales (_kv_quant_write),
+    dequantize at every gather — and inside the Pallas kernel, where
+    the scales arrive as page-table-indexed operands so the pool stays
+    int8 all the way into VMEM. Halves persistent KV HBM (the slot
+    ceiling) for a <1pt greedy-token quality delta; `quant=False` is
+    byte-identical to the pre-quant layout.
 
     Page 0 is the null/trash page by contract: never allocated to a
     request, it absorbs padded-position and inactive-slot writes; reads
@@ -323,6 +399,23 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
     def head(params, top_ads, rank_scale, x):
         return lm_head_logits(params, top_ads, rank_scale, x, dtype, eps)
 
+    def cxs(cache):
+        """Cache leaves in scan-xs order (scales ride when quantized)."""
+        base = (cache["k"], cache["v"])
+        return base + ((cache["ks"], cache["vs"]) if quant else ())
+
+    def cout(cc):
+        out = {"k": cc[0], "v": cc[1]}
+        if quant:
+            out["ks"], out["vs"] = cc[2], cc[3]
+        return out
+
+    def dq_pages(pool, scales, idx):
+        """Gather pages + in-place dequant: scales[idx] [..., H]
+        broadcast over the (page_size, Dh) axes of pool[idx]."""
+        g = pool[idx].astype(jnp.float32)
+        return (g * scales[idx][..., None, :, None]).astype(dtype)
+
     def chunk(params, adapters, cache, pages_row, tokens, t0, length):
         blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
         emb = dq(params["embed"]["embedding"])
@@ -337,18 +430,28 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
         n_virt = pages_row.shape[0] * ps
 
         def body(x, layer):
-            bl, ad_l, ck, cv = layer                      # ck/cv [P,ps,H,Dh]
+            if quant:
+                bl, ad_l, ck, cv, ks, vs = layer
+            else:
+                bl, ad_l, ck, cv = layer                  # ck/cv [P,ps,H,Dh]
             h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
             q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
             q = _rope_rows(q, posr[None, :])
             k = _rope_rows(k, posr[None, :])
-            ck = ck.at[wpage, woff].set(k[0])
-            cv = cv.at[wpage, woff].set(v[0])
+            if quant:
+                ck, ks = _kv_quant_write(ck, ks, wpage, woff, k[0])
+                cv, vs = _kv_quant_write(cv, vs, wpage, woff, v[0])
+                kk = dq_pages(ck, ks, pages_row)
+                vv = dq_pages(cv, vs, pages_row)
+            else:
+                ck = ck.at[wpage, woff].set(k[0])
+                cv = cv.at[wpage, woff].set(v[0])
+                kk, vv = ck[pages_row], cv[pages_row]
             # gather AFTER the write so the chunk attends to itself;
             # page-table order makes the gathered view contiguous virtual
             # positions 0..n_virt-1
-            kk = ck[pages_row].reshape((n_virt,) + ck.shape[2:])
-            vv = cv[pages_row].reshape((n_virt,) + cv.shape[2:])
+            kk = kk.reshape((n_virt,) + ck.shape[2:])
+            vv = vv.reshape((n_virt,) + cv.shape[2:])
             scale = q.shape[-1] ** -0.5
             s = jnp.einsum("bqhd,khd->bhqk", q, kk) * scale
             live = jnp.arange(n_virt)[None, :] <= posr[:, None]  # [C, T]
@@ -357,14 +460,14 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
             x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
                 bl, ad_l, "wo", rank_scale)
             x = mlp(bl, ad_l, rank_scale, x)
-            return x, (ck, cv)
+            return x, ((ck, cv, ks, vs) if quant else (ck, cv))
 
-        x, (ck, cv) = jax.lax.scan(
-            body, x, (params["blocks"], blk_ads, cache["k"], cache["v"]))
+        x, cc = jax.lax.scan(
+            body, x, (params["blocks"], blk_ads) + cxs(cache))
         last = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0,
                                             keepdims=False)
         logits = head(params, top_ads, rank_scale, last[None, None])
-        return {"k": ck, "v": cv}, logits[:, 0]
+        return cout(cc), logits[:, 0]
 
     if kernel:
         from ..ops.paged_attention import paged_attention
@@ -381,14 +484,29 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
             # pool (partition.paged_kv_cache_spec) reaches the kernel
             # as-is: each device runs it over its own heads, the page
             # table/positions replicated — no resharding, no collective
-            attn_fused = shard_map(
-                lambda q, kp, vp, pg, po: paged_attention(q, kp, vp, pg, po),
-                mesh=mesh,
-                in_specs=(P(None, None, "mp", None),
-                          P(None, None, "mp", None),
-                          P(None, None, "mp", None),
-                          P(None, None), P(None)),
-                out_specs=P(None, None, "mp", None), check_rep=False)
+            # (the int8 scales split the same heads axis:
+            # partition.paged_kv_scale_spec)
+            if quant:
+                attn_fused = shard_map(
+                    lambda q, kp, vp, pg, po, ksc, vsc: paged_attention(
+                        q, kp, vp, pg, po, ksc, vsc),
+                    mesh=mesh,
+                    in_specs=(P(None, None, "mp", None),
+                              P(None, None, "mp", None),
+                              P(None, None, "mp", None),
+                              P(None, None), P(None),
+                              P(None, "mp"), P(None, "mp")),
+                    out_specs=P(None, None, "mp", None), check_rep=False)
+            else:
+                attn_fused = shard_map(
+                    lambda q, kp, vp, pg, po: paged_attention(
+                        q, kp, vp, pg, po),
+                    mesh=mesh,
+                    in_specs=(P(None, None, "mp", None),
+                              P(None, None, "mp", None),
+                              P(None, None, "mp", None),
+                              P(None, None), P(None)),
+                    out_specs=P(None, None, "mp", None), check_rep=False)
 
     def verify(params, adapters, cache, pages, pos, tokens, active):
         """C tokens per slot through one forward (C = tokens.shape[1];
@@ -416,20 +534,34 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
         n_virt = max_pages * ps
 
         def body(x, layer):
-            bl, ad_l, ck, cv = layer
+            if quant:
+                bl, ad_l, ck, cv, ks, vs = layer
+            else:
+                bl, ad_l, ck, cv = layer
             h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
             q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
             q = _rope_rows(q, posr)
             k = _rope_rows(k, posr)
-            ck = ck.at[wpage, woff].set(k)
-            cv = cv.at[wpage, woff].set(v)
+            if quant:
+                ck, ks = _kv_quant_write(ck, ks, wpage, woff, k)
+                cv, vs = _kv_quant_write(cv, vs, wpage, woff, v)
+            else:
+                ck = ck.at[wpage, woff].set(k)
+                cv = cv.at[wpage, woff].set(v)
             if kernel:
                 # fused path: pages read in place by the Pallas kernel —
-                # no virtually-contiguous copy materializes
-                o = attn_fused(q, ck, cv, pages, pos)
+                # no virtually-contiguous copy materializes (int8 pools
+                # ride in as-is; the kernel dequants each slab in VMEM)
+                o = (attn_fused(q, ck, cv, pages, pos, ks, vs)
+                     if quant else attn_fused(q, ck, cv, pages, pos))
             else:
-                kk = ck[pages].reshape((s_, n_virt) + ck.shape[2:])
-                vv = cv[pages].reshape((s_, n_virt) + cv.shape[2:])
+                if quant:
+                    kk = dq_pages(ck, ks, pages)
+                    vv = dq_pages(cv, vs, pages)
+                else:
+                    kk, vv = ck[pages], cv[pages]
+                kk = kk.reshape((s_, n_virt) + ck.shape[2:])
+                vv = vv.reshape((s_, n_virt) + cv.shape[2:])
                 scale = q.shape[-1] ** -0.5
                 s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
                 live = (jnp.arange(n_virt)[None, None, :]
@@ -440,19 +572,81 @@ def make_paged_kv_decode(n_heads: int, page_size: int, alpha: float = 16.0,
             x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
                 bl, ad_l, "wo", rank_scale)
             x = mlp(bl, ad_l, rank_scale, x)
-            return x, (ck, cv)
+            return x, ((ck, cv, ks, vs) if quant else (ck, cv))
 
-        x, (ck, cv) = jax.lax.scan(
-            body, x, (params["blocks"], blk_ads, cache["k"], cache["v"]))
+        x, cc = jax.lax.scan(
+            body, x, (params["blocks"], blk_ads) + cxs(cache))
         logits = head(params, top_ads, rank_scale, x)
-        return {"k": ck, "v": cv}, logits
+        return cout(cc), logits
 
     def step(params, adapters, cache, pages, pos, token, active):
         cache, logits = verify(params, adapters, cache, pages, pos,
                                token[:, None], active)
         return cache, logits[:, 0]
 
-    return chunk, step, verify
+    def chunk_batch(params, adapters, cache, pages, tokens, t0, lengths):
+        """Batched admission prefill (docstring above): verify-shaped
+        positions (per-row t0), chunk-shaped write masking (tokens past
+        a row's length — and PAD rows entirely — redirect to the null
+        page), per-row last-live-position logits."""
+        blk_ads, top_ads, rank_scale = split_adapters(adapters, alpha)
+        emb = dq(params["embed"]["embedding"])
+        x = emb[tokens]                                   # [B, C, D]
+        b_, c = tokens.shape
+        j = jnp.arange(c)
+        t0 = jnp.asarray(t0, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        posr = t0[:, None] + j[None, :]                   # [B, C]
+        max_pages = pages.shape[1]
+        rowidx = posr // ps
+        wpage = jnp.where(
+            (j[None, :] < lengths[:, None]) & (rowidx < max_pages),
+            pages[jnp.arange(b_)[:, None],
+                  jnp.minimum(rowidx, max_pages - 1)], 0)
+        woff = posr % ps
+        n_virt = max_pages * ps
+
+        def body(x, layer):
+            if quant:
+                bl, ad_l, ck, cv, ks, vs = layer
+            else:
+                bl, ad_l, ck, cv = layer
+            h = norm(x, dq(bl["RMSNorm_0"]["scale"]))
+            q, k, v = qkv(bl, ad_l, rank_scale, h, n_heads)
+            q = _rope_rows(q, posr)
+            k = _rope_rows(k, posr)
+            if quant:
+                ck, ks = _kv_quant_write(ck, ks, wpage, woff, k)
+                cv, vs = _kv_quant_write(cv, vs, wpage, woff, v)
+                kk = dq_pages(ck, ks, pages)
+                vv = dq_pages(cv, vs, pages)
+            else:
+                ck = ck.at[wpage, woff].set(k)
+                cv = cv.at[wpage, woff].set(v)
+                kk, vv = ck[pages], cv[pages]
+            kk = kk.reshape((b_, n_virt) + ck.shape[2:])
+            vv = vv.reshape((b_, n_virt) + cv.shape[2:])
+            scale = q.shape[-1] ** -0.5
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+            live = (jnp.arange(n_virt)[None, None, :]
+                    <= posr[:, :, None])                     # [B, C, T]
+            s = jnp.where(live[:, None, :, :], s, _NEG)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vv)
+            x = x + o.reshape(x.shape[:2] + (-1,)) @ merged(
+                bl, ad_l, "wo", rank_scale)
+            x = mlp(bl, ad_l, rank_scale, x)
+            return x, ((ck, cv, ks, vs) if quant else (ck, cv))
+
+        x, cc = jax.lax.scan(
+            body, x, (params["blocks"], blk_ads) + cxs(cache))
+        # per-row last live position (PAD rows clamp to 0 — garbage the
+        # engine discards alongside their dropped scatters)
+        last = jax.vmap(lambda xr, n: jax.lax.dynamic_index_in_dim(
+            xr, jnp.maximum(n, 1) - 1, axis=0, keepdims=False))(x, lengths)
+        logits = head(params, top_ads, rank_scale, last[:, None])
+        return cout(cc), logits[:, 0]
+
+    return chunk, step, verify, chunk_batch
 
 
 def ngram_propose(hist, pos, k: int, w: int = 2):

@@ -29,6 +29,13 @@ layer):
     pos    [S] int32       first query position per slot
     ->     [S, C, H, Dh]
 
+With an int8 pool (`kv_quant: int8`), the per-(page, head) f32 scales
+[P, H] ride as two further operands whose BlockSpec index maps read the
+SAME scalar-prefetched page-table entry as the K/V slabs: each grid
+step DMAs its page's (1, H) scale rows alongside the (page_size, H, Dh)
+int8 slab and dequantizes in VMEM — the pool crosses HBM at one byte
+per element, which is the whole point.
+
 Semantics match the gather path exactly: query i of slot s attends
 virtual positions <= pos[s] + i of the slot's page-table view (the
 active-mask write redirect and the null-page-0 convention live in the
@@ -74,8 +81,16 @@ def _dot(a, b, contract, batch):
         preferred_element_type=jnp.float32, precision=prec)
 
 
-def _kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-            o_acc, m_acc, l_acc, *, page_size: int, scale: float):
+def _kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+            page_size: int, scale: float, quant: bool):
+    if quant:
+        # int8 pool: the per-(page, head) scales ride as two extra
+        # operands whose index map follows the SAME page-table entry as
+        # the K/V slabs — each grid step sees exactly its page's scales
+        ks_ref, vs_ref, o_ref, o_acc, m_acc, l_acc = rest
+    else:
+        o_ref, o_acc, m_acc, l_acc = rest
+        ks_ref = vs_ref = None
     s_idx, pj = pl.program_id(0), pl.program_id(1)
     n_pb = pl.num_programs(1)
     pos = pos_ref[s_idx]
@@ -93,6 +108,14 @@ def _kernel(pages_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                   # [C, H, Dh]
         kb = k_ref[0]                                  # [ps, H, Dh]
         vb = v_ref[0]
+        if quant:
+            # in-place dequant of the DMA'd slab: the pool stays int8 in
+            # HBM and on the wire; f32 rows exist only in VMEM, cast to
+            # the query dtype so the MXU contract matches the bf16 path
+            kb = (kb.astype(jnp.float32)
+                  * ks_ref[0][None, :, None]).astype(q.dtype)
+            vb = (vb.astype(jnp.float32)
+                  * vs_ref[0][None, :, None]).astype(q.dtype)
         # scores per head: batch H, contract Dh -> [H, C, ps]
         s = _dot(q, kb, ((2,), (2,)), ((1,), (1,))) * scale
         qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, c, 1), 1)
@@ -121,23 +144,32 @@ def _auto_interpret() -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _call(q, k_pool, v_pool, pages, pos, interpret: bool):
+def _call(q, k_pool, v_pool, pages, pos, scales, interpret: bool):
     s_, c, h, dh = q.shape
     page_size = k_pool.shape[1]
     max_pages = pages.shape[1]
     scale = dh ** -0.5
+    quant = scales is not None
+    in_specs = [
+        pl.BlockSpec((1, c, h, dh), lambda s, p, pt, ps_: (s, 0, 0, 0)),
+        # THE paged read: the page table entry picks which pool slab
+        # this grid step sees — no gathered copy ever materializes
+        pl.BlockSpec((1, page_size, h, dh),
+                     lambda s, p, pt, ps_: (pt[s, p], 0, 0, 0)),
+        pl.BlockSpec((1, page_size, h, dh),
+                     lambda s, p, pt, ps_: (pt[s, p], 0, 0, 0)),
+    ]
+    operands = [pages, pos, q, k_pool, v_pool]
+    if quant:
+        # per-(page, head) f32 scales [P, H], page-table-indexed like
+        # the slabs they dequantize
+        in_specs += [pl.BlockSpec((1, h), lambda s, p, pt, ps_: (pt[s, p], 0)),
+                     pl.BlockSpec((1, h), lambda s, p, pt, ps_: (pt[s, p], 0))]
+        operands += [scales[0], scales[1]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,     # pages + pos steer the index maps
         grid=(s_, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, c, h, dh), lambda s, p, pt, ps_: (s, 0, 0, 0)),
-            # THE paged read: the page table entry picks which pool slab
-            # this grid step sees — no gathered copy ever materializes
-            pl.BlockSpec((1, page_size, h, dh),
-                         lambda s, p, pt, ps_: (pt[s, p], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, h, dh),
-                         lambda s, p, pt, ps_: (pt[s, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, c, h, dh),
                                lambda s, p, pt, ps_: (s, 0, 0, 0)),
         scratch_shapes=[
@@ -147,21 +179,28 @@ def _call(q, k_pool, v_pool, pages, pos, interpret: bool):
         ],
     )
     return pl.pallas_call(
-        functools.partial(_kernel, page_size=page_size, scale=scale),
+        functools.partial(_kernel, page_size=page_size, scale=scale,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_, c, h, dh), q.dtype),
         interpret=interpret,
-    )(pages, pos, q, k_pool, v_pool)
+    )(*operands)
 
 
 def paged_attention(q, k_pool, v_pool, pages, pos,
+                    k_scales=None, v_scales=None,
                     interpret: bool | None = None):
     """Fused paged decode attention (module docstring has the contract).
 
     q [S, C, H, Dh], k/v pool [P, page_size, H, Dh], pages [S, max_pages]
-    int32, pos [S] int32 -> [S, C, H, Dh]."""
+    int32, pos [S] int32 -> [S, C, H, Dh]. With an int8 pool, k_scales /
+    v_scales [P, H] f32 per-(page, head) scales must both ride along —
+    each slab is dequantized in VMEM right after its DMA."""
     if interpret is None:
         interpret = _auto_interpret()
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
     pages = jnp.asarray(pages, jnp.int32)
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (q.shape[0],))
-    return _call(q, k_pool, v_pool, pages, pos, bool(interpret))
+    scales = None if k_scales is None else (k_scales, v_scales)
+    return _call(q, k_pool, v_pool, pages, pos, scales, bool(interpret))

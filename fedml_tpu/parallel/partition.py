@@ -274,6 +274,17 @@ def paged_kv_cache_spec(axis: str = "mp"):
     return P(None, None, None, axis, None)
 
 
+def paged_kv_scale_spec(axis: str = "mp"):
+    """PartitionSpec for the int8 paged pool's per-(page, head) scales
+    `[L, n_pages, H]` (`kv_quant: int8`): heads sharded over `axis` like
+    the pool rows they dequantize, page axis replicated — a scale leaf
+    landing on the wrong chip would force a gather in front of every
+    in-place dequant."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, axis)
+
+
 TABLES = {
     "transformer_lm": transformer_lm_rules,
     "mlp_cnn": mlp_cnn_rules,

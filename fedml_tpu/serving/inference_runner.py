@@ -19,10 +19,15 @@ Fleet surface (ISSUE 9):
   gateway's failover relay) can always tell a half-stream from a
   complete one.
 - GET /info reports `{"model_version", "queue_depth", "slots_active",
-  "decode_queue", "draining"}` — the version signal the gateway's
-  rolling updater converges on, plus the load snapshot operators and
-  telemetry read (routing itself is least-loaded over the GATEWAY's own
-  per-replica in-flight accounting, not /info polls).
+  "decode_queue", "draining", "kv_page_size", "prefix_digests"}` — the
+  version signal the gateway's rolling updater converges on, plus the
+  load snapshot operators and telemetry read (routing itself is
+  least-loaded over the GATEWAY's own per-replica in-flight accounting,
+  not /info polls). `kv_page_size`/`prefix_digests` are the
+  prefix-affinity residency advert (ISSUE 16): which first-page
+  prefix-cache keys this replica's engine holds. The same advert rides
+  every /predict response as `X-KV-Page-Size`/`X-Prefix-Digest`
+  headers, so the gateway's hint stays fresh off the warm path alone.
 - POST /swap `{"store": <utils.artifacts.store_spec>, "name": ...,
   "version": N}` fetches round-N adapters from the artifact store and
   hot-swaps them into the live predictor (no restart; engine story in
@@ -76,7 +81,8 @@ class FedMLInferenceRunner:
             def log_message(self, fmt, *args):  # quiet the default stderr spam
                 log.debug("serving: " + fmt, *args)
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict,
+                      headers: Optional[dict] = None) -> None:
                 # a chaos-killed replica runs no cleanup: connections that
                 # were in flight when the kill landed are severed before
                 # any response byte (real process death answers nobody)
@@ -86,8 +92,24 @@ class FedMLInferenceRunner:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _residency_headers(self) -> Optional[dict]:
+                """Prefix-affinity advert for the routing gateway: the
+                engine's page geometry + its resident first-page prefix
+                digests, stamped on every /predict response (and the SSE
+                head) so the gateway learns residency off the warm path
+                without polling /info. None for non-engine predictors
+                and contiguous/prefix-off engines — the headers' absence
+                IS the "no affinity signal" case."""
+                eng = getattr(runner.predictor, "engine", None)
+                if eng is None or not getattr(eng, "kv_page_size", 0):
+                    return None
+                return {"X-KV-Page-Size": str(eng.kv_page_size),
+                        "X-Prefix-Digest": ",".join(eng.prefix_digests())}
 
             def do_GET(self):
                 if runner._killed:
@@ -113,6 +135,10 @@ class FedMLInferenceRunner:
                                          if eng is not None else None),
                         "draining": (bool(eng._draining)
                                      if eng is not None else False),
+                        "kv_page_size": (getattr(eng, "kv_page_size", 0)
+                                         if eng is not None else 0),
+                        "prefix_digests": (eng.prefix_digests()
+                                           if eng is not None else []),
                     })
                 elif self.path == "/metrics":
                     # replicas expose the process registry (request latency,
@@ -186,7 +212,11 @@ class FedMLInferenceRunner:
                         result = runner.predictor.predict(input_json)
                         if not isinstance(result, dict):
                             result = {"generated_text": str(result)}
-                        self._send(200, result)
+                        # residency read AFTER the predict: this
+                        # prompt's own first page is already registered,
+                        # so the advert includes it
+                        self._send(200, result,
+                                   headers=self._residency_headers())
                 except ConnectionError as e:
                     # the peer can't receive another byte: the client hung
                     # up, or a chaos kill severed this replica mid-stream.
@@ -229,6 +259,11 @@ class FedMLInferenceRunner:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
+                # the first chunk was pulled above, so admission already
+                # registered this prompt's prefix — the SSE head can
+                # advertise residency like the non-stream path
+                for k, v in (self._residency_headers() or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 _mx.inc("serving.stream_responses")
                 _mx.observe("serving.stream_ttft",

@@ -60,8 +60,16 @@ KNOBS = {
     "spec_k":             {"kind": "int", "min": 1,
                            "consumer": "predictor",
                            "requires": "spec_decode"},
+    "kv_quant":           {"kind": "choice", "choices": ["off", "int8"],
+                           "consumer": "predictor",
+                           "requires": "kv_page_size"},
+    "admit_batch":        {"kind": "int", "min": 1,
+                           "consumer": "predictor",
+                           "requires": "decode_slots"},
     "drain_timeout_s":    {"kind": "num", "strict": False,
                            "consumer": "predictor"},
+    "affinity_routing":   {"kind": "bool", "consumer": "fleet",
+                           "requires": "prefix_cache"},
     "shed_watermark":     {"kind": "num", "strict": False,
                            "consumer": "fleet"},
     "retry_after_s":      {"kind": "num", "strict": True,
@@ -194,3 +202,40 @@ def validate_serve_args(extra: dict) -> None:
             "serve_args.spec_k requires spec_decode: ngram — "
             "the draft length only exists under speculation; "
             "without it the knob would be silently ignored")
+    # serving-density knobs (ISSUE 16): int8 KV pages, batched
+    # admission, and gateway prefix-affinity routing — same discipline
+    kq = extra.get("kv_quant")
+    if kq is not None:
+        # YAML 1.1 reads unquoted `off` as False — the documented
+        # disable spelling, same normalization as spec_decode
+        if kq is False:
+            kq = extra["kv_quant"] = "off"
+        if kq is True:
+            raise ValueError(
+                "serve_args.kv_quant: true is not a mode — use 'int8' "
+                "(YAML parses unquoted off/on as booleans; quote the "
+                "value)")
+        if kq not in KNOBS["kv_quant"]["choices"]:
+            raise ValueError(
+                f"serve_args.kv_quant must be 'off' or 'int8'; got {kq!r}")
+        if kq != "off" and not extra.get("kv_page_size"):
+            raise ValueError(
+                "serve_args.kv_quant requires kv_page_size > 0 — int8 "
+                "KV storage is a property of the paged pool (per-page-"
+                "per-head scales ride the page table); without paging "
+                "the knob would be silently ignored")
+    ab = extra.get("admit_batch")
+    if ab is not None and int(ab) > 1 and not extra.get("decode_slots"):
+        raise ValueError(
+            "serve_args.admit_batch > 1 requires decode_slots > 0 — "
+            "batched admission groups the decode engine's prefill "
+            "chunks; without slots the knob would be silently ignored")
+    if extra.get("affinity_routing"):
+        if not extra.get("kv_page_size") \
+                or extra.get("prefix_cache") is False:
+            raise ValueError(
+                "serve_args.affinity_routing requires the engine prefix "
+                "cache (kv_page_size > 0, prefix_cache not disabled) — "
+                "affinity routes requests to the replica whose cache "
+                "already holds their prefix; without one the knob would "
+                "be silently ignored")

@@ -143,6 +143,10 @@ def lm_predictor_from_serve_knobs(sv: dict, model, params,
         spec_decode=("off" if sv.get("spec_decode") in (None, False)
                      else str(sv.get("spec_decode"))),
         spec_k=int(sv.get("spec_k", 4)),
+        # same YAML-1.1 normalization: unquoted `off` parses as False
+        kv_quant=("off" if sv.get("kv_quant") in (None, False)
+                  else str(sv.get("kv_quant"))),
+        admit_batch=int(sv.get("admit_batch", 1)),
         drain_timeout_s=float(sv.get("drain_timeout_s", 30.0)))
 
 
@@ -251,6 +255,7 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                  kv_n_pages: Optional[int] = None, prefill_chunk: int = 0,
                  prefix_cache: bool = True, paged_kernel: bool = False,
                  spec_decode: str = "off", spec_k: int = 4,
+                 kv_quant: str = "off", admit_batch: int = 1,
                  drain_timeout_s: float = 30.0):
         self.model = model
         self.params = params
@@ -283,6 +288,19 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                 "paged_kernel/spec_decode need the PAGED engine "
                 "(kv_page_size > 0, which itself needs decode_slots) — "
                 "otherwise they would be silently ignored")
+        if kv_quant != "off" and not kv_page_size:
+            # int8 KV is a property of the PAGED pool (per-page-per-head
+            # scales ride the page table) — without it the knob would be
+            # silently ignored
+            raise ValueError(
+                "kv_quant stores the PAGED KV pool in int8 — it needs "
+                "kv_page_size > 0 (which itself needs decode_slots); "
+                "otherwise it would be silently ignored")
+        if int(admit_batch) > 1 and not decode_slots:
+            raise ValueError(
+                "admit_batch batches the decode ENGINE's admissions — "
+                "it needs decode_slots > 0 (otherwise it would be "
+                "silently ignored)")
 
         if adapters is not None and not kv_cache:
             # the recompute path drives model.apply, which knows nothing of
@@ -382,7 +400,8 @@ class GreedyLMPredictor(_InstrumentedPredictor):
                     prefill_chunk=prefill_chunk,
                     prefix_cache=prefix_cache,
                     paged_kernel=paged_kernel, spec_decode=spec_decode,
-                    spec_k=spec_k).start()
+                    spec_k=spec_k, kv_quant=kv_quant,
+                    admit_batch=int(admit_batch)).start()
             return
 
         # n_steps is a Python int at trace time (scan length must be

@@ -122,6 +122,8 @@ def fleet_knobs(sv: dict) -> tuple[dict, dict]:
         gw_kw["shed_watermark"] = float(sv["shed_watermark"])
     if sv.get("retry_after_s") is not None:
         gw_kw["retry_after_s"] = float(sv["retry_after_s"])
+    if sv.get("affinity_routing") is not None:
+        gw_kw["affinity"] = bool(sv["affinity_routing"])
     return dep_kw, gw_kw
 
 
@@ -232,6 +234,13 @@ class _Replica:
         self.inflight = 0
         # last model_version this replica reported (/info; rolling update)
         self.model_version: Optional[int] = None
+        # prefix-affinity residency hint: the first-page prefix digests
+        # this replica's engine advertised (X-Prefix-Digest response
+        # header / the /info "prefix_digests" field) and its page
+        # geometry. Written by the gateway off successful responses,
+        # read lock-free at routing time — a HINT, never correctness
+        self.page_size = 0
+        self.prefix_digests: frozenset = frozenset()
 
 
 class Deployment:
@@ -360,7 +369,8 @@ class Deployment:
         _mx.set_gauge("serving.replicas_suspect", states.count(R_SUSPECT))
 
     # ------------------------------------------------------------ routing
-    def acquire(self, exclude: Optional[set] = None) -> Optional[_Replica]:
+    def acquire(self, exclude: Optional[set] = None,
+                prefer: Optional[frozenset] = None) -> Optional[_Replica]:
         """Least-loaded pick: among READY replicas, the one with the
         fewest gateway-tracked in-flight requests (round-robin breaks
         ties), with its inflight count already incremented — the caller
@@ -369,12 +379,21 @@ class Deployment:
         idled; in-flight depth is the signal the gateway actually has.
         `exclude` skips replica_ids the caller already ruled out this
         request (the 409 version-pin reroute: an idle stale replica
-        would otherwise win least-loaded on every retry)."""
+        would otherwise win least-loaded on every retry). `prefer`
+        (prefix-affinity routing) restricts the pick to those
+        replica_ids when any of them is READY and not excluded —
+        otherwise the full pool competes, so affinity can only ever
+        REORDER healthy candidates, never starve a request behind a
+        SUSPECT/DEAD/stale preferred replica."""
         with self._lock:
             ready = [r for r in self.replicas if r.state == R_READY
                      and (not exclude or r.replica_id not in exclude)]
             if not ready:
                 return None
+            if prefer:
+                hot = [r for r in ready if r.replica_id in prefer]
+                if hot:
+                    ready = hot
             self._rr += 1
             rep = min(
                 (r for r in ready),
@@ -654,14 +673,30 @@ class InferenceGateway:
     in-flight requests exceed `shed_watermark × ready_replicas`, new
     requests are refused with 429 + a Retry-After header (`retry_after_s`)
     instead of queueing toward timeout — overload degrades to fast
-    refusal the client can act on. Sheds ride `serving.shed_total`."""
+    refusal the client can act on. Sheds ride `serving.shed_total`.
+
+    `affinity` arms PREFIX-AFFINITY routing (ISSUE 16): replicas
+    advertise which first-page prefix-cache keys are resident
+    (X-Prefix-Digest/X-KV-Page-Size response headers, harvested off
+    every successful forward; also on /info). The gateway hashes each
+    prompt's leading page-aligned block with the engine's own chain
+    hash and PREFERS a replica already holding that page — under a
+    many-user Zipf mix this turns N independent prefix caches into one
+    fleet-wide cache instead of N-way-diluting every hot prefix. The
+    preference composes with (never overrides) the existing discipline:
+    shed fires first, SUSPECT/excluded replicas are never preferred
+    into, and when no advertiser is routable the pick falls back to
+    plain least-loaded. Outcomes ride serving.affinity.{hits,misses,
+    fallbacks}, counted once per request at its first placement."""
 
     def __init__(self, deployment: Deployment, host: str = "127.0.0.1",
                  port: int = 0, high_water: float = 2.0,
                  low_water: float = 0.25, scale_interval: float = 0.5,
                  retry_backoff_s: float = 0.05,
-                 shed_watermark: float = 0.0, retry_after_s: float = 1.0):
+                 shed_watermark: float = 0.0, retry_after_s: float = 1.0,
+                 affinity: bool = False):
         self.dep = deployment
+        self.affinity = bool(affinity)
         # AtomicCounter (utils/metrics.py): += on the threading server
         # would race and drift the autoscaler's load signal; the gauge is
         # bound so it publishes under the counter's own lock
@@ -735,7 +770,7 @@ class InferenceGateway:
                     if isinstance(parsed, dict) and parsed.get("stream"):
                         gateway.forward_stream(body, self, parsed=parsed)
                         return
-                    code, payload = gateway.forward(body)
+                    code, payload = gateway.forward(body, parsed=parsed)
                     self._send(code, payload)
                 finally:
                     gateway._inflight.dec()
@@ -761,16 +796,96 @@ class InferenceGateway:
             return False     # no-replica case stays a 503, not a shed
         return self._inflight.value() > self.shed_watermark * ready
 
+    # ------------------------------------------------- prefix affinity
+    def _affinity_prefer(self, parsed,
+                         body: bytes) -> Optional[frozenset]:
+        """replica_ids advertising THIS prompt's first page as resident,
+        or None when affinity routing is off. Hashes the prompt's
+        leading page-aligned block with the engine's own chain hash
+        (engine._page_key, parent b"\\x00" — the same key the replica's
+        prefix cache registered), per distinct advertised page size, so
+        the probe can never drift from what replicas actually store. An
+        empty frozenset means no routable advertiser (cold prefix,
+        prompt shorter than a page, or a non-token request) — the
+        caller counts it a miss and routes least-loaded."""
+        if not self.affinity:
+            return None
+        if parsed is None:
+            try:
+                parsed = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                parsed = None
+        toks = parsed.get("tokens") if isinstance(parsed, dict) else None
+        if not isinstance(toks, list) or not toks:
+            return frozenset()
+        from .engine import _page_key
+        digest: dict = {}        # page_size -> first-page hex digest
+        pref = set()
+        for rep in self.dep.ready_replicas():
+            ps = rep.page_size
+            if ps <= 0 or not rep.prefix_digests or len(toks) < ps:
+                continue
+            if ps not in digest:
+                try:
+                    digest[ps] = _page_key(b"\x00", toks[:ps]).hex()
+                except (TypeError, ValueError, OverflowError):
+                    digest[ps] = None    # non-int tokens: replica 400s it
+            if digest[ps] is not None \
+                    and digest[ps] in rep.prefix_digests:
+                pref.add(rep.replica_id)
+        return frozenset(pref)
+
+    def _count_affinity(self, rep: _Replica,
+                        prefer: Optional[frozenset]) -> None:
+        """Outcome counter, called once per request at its FIRST
+        placement (retries re-place the same request — counting them
+        would double-weight failovers): hit = landed on an advertiser,
+        fallback = an advertiser existed but was not routable
+        (SUSPECT/excluded/not READY), miss = nothing advertised the
+        prefix."""
+        if prefer is None:
+            return
+        if not prefer:
+            _mx.inc("serving.affinity.misses")
+        elif rep.replica_id in prefer:
+            _mx.inc("serving.affinity.hits")
+        else:
+            _mx.inc("serving.affinity.fallbacks")
+
+    def _note_residency(self, rep: _Replica, headers) -> None:
+        """Harvest a replica's residency advert off a successful
+        response's X-KV-Page-Size / X-Prefix-Digest headers — the warm
+        path keeps the hint fresh without an /info poll per request.
+        Whole-set replacement (not a merge): the replica advertises its
+        CURRENT resident first pages, and eviction must be able to
+        retire stale digests."""
+        if not self.affinity:
+            return
+        try:
+            ps = int(headers.get("X-KV-Page-Size") or 0)
+        except (TypeError, ValueError):
+            return
+        if ps <= 0:
+            return
+        dg = headers.get("X-Prefix-Digest")
+        rep.page_size = ps
+        rep.prefix_digests = frozenset(
+            d for d in (dg or "").split(",") if d)
+
     # ---------------------------------------------------------- routing
-    def forward(self, body: bytes, tries: int = 3) -> tuple[int, dict]:
+    def forward(self, body: bytes, tries: int = 3,
+                parsed: Optional[dict] = None) -> tuple[int, dict]:
         """Least-loaded with failover: a replica that errors at the
         transport level (or 5xx) goes to PROBATION and the request
         retries elsewhere; a 409 (stale version pin) reroutes to a
-        sibling without suspecting anyone."""
+        sibling without suspecting anyone. With affinity routing on,
+        the least-loaded pick is restricted to replicas advertising the
+        prompt's first prefix page whenever one is routable. `parsed`
+        is the decoded body when do_POST already parsed it."""
         t0 = time.perf_counter()
         try:
             with recorder.span("serving.forward"):
-                return self._forward(body, tries)
+                return self._forward(body, tries, parsed)
         finally:
             _mx.observe("serving.gateway_forward_s",
                         time.perf_counter() - t0)
@@ -788,9 +903,12 @@ class InferenceGateway:
         except (json.JSONDecodeError, OSError):
             return 409, {"error": "stale model_version"}
 
-    def _forward(self, body: bytes, tries: int) -> tuple[int, dict]:
+    def _forward(self, body: bytes, tries: int,
+                 parsed: Optional[dict] = None) -> tuple[int, dict]:
         last_409: Optional[tuple[int, dict]] = None
         stale: set = set()       # replicas that 409'd this request's pin
+        prefer = self._affinity_prefer(parsed, body)
+        counted = False
         for attempt in range(tries):
             if attempt:
                 # short exponential backoff between failover attempts — a
@@ -798,14 +916,18 @@ class InferenceGateway:
                 # hammering the next pick during a correlated outage just
                 # burns the retry budget in microseconds
                 time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
-            rep = self.dep.acquire(exclude=stale)
+            rep = self.dep.acquire(exclude=stale, prefer=prefer)
             if rep is None:
                 return last_409 or (503, {"error": "no ready replicas"})
+            if not counted:
+                self._count_affinity(rep, prefer)
+                counted = True
             req = urllib.request.Request(
                 rep.endpoint + "/predict", data=body,
                 headers={"Content-Type": "application/json"})
             try:
                 with urllib.request.urlopen(req, timeout=30) as r:
+                    self._note_residency(rep, r.headers)
                     return r.status, json.loads(r.read() or b"{}")
             except urllib.error.HTTPError as e:
                 if e.code == 409:
@@ -892,6 +1014,10 @@ class InferenceGateway:
         # otherwise the canonical cut+skew recovery always lands on the
         # last attempt with nothing left for a second fault
         cont_dispatch = False
+        # affinity preference from the ORIGINAL prompt: a continuation
+        # re-issue extends the same prefix, so the hint stays valid
+        prefer = self._affinity_prefer(parsed, body)
+        counted = False
         while True:
             if not cont_dispatch:
                 if attempts >= tries:
@@ -900,14 +1026,18 @@ class InferenceGateway:
                 if attempts > 1:
                     time.sleep(self.retry_backoff_s * (2 ** (attempts - 2)))
             cont_dispatch = False
-            rep = self.dep.acquire(exclude=stale)
+            rep = self.dep.acquire(exclude=stale, prefer=prefer)
             if rep is None:
                 break
+            if not counted:
+                self._count_affinity(rep, prefer)
+                counted = True
             req = urllib.request.Request(
                 rep.endpoint + "/predict", data=body,
                 headers={"Content-Type": "application/json"})
             try:
                 with urllib.request.urlopen(req, timeout=120) as r:
+                    self._note_residency(rep, r.headers)
                     for ev in self._sse_events(r):
                         if "token" in ev:
                             # indices are the UPSTREAM request's frame;

@@ -240,9 +240,13 @@ class _StubDep:
     def ready_replicas(self):
         return [r for r in self.reps if r.state == "READY"]
 
-    def acquire(self, exclude=None):
+    def acquire(self, exclude=None, prefer=None):
         ready = [r for r in self.ready_replicas()
                  if not exclude or r.replica_id not in exclude]
+        if prefer:          # same semantics as Deployment.acquire:
+            hot = [r for r in ready if r.replica_id in prefer]
+            if hot:         # affinity only reorders healthy candidates
+                ready = hot
         if ready:
             ready[0].inflight += 1
         return ready[0] if ready else None
