@@ -358,6 +358,53 @@ def bench_workload1_mnist_lr() -> dict:
     except Exception as e:  # noqa: BLE001
         out["w1_health_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # attribution-plane overhead (ISSUE 17): the SAME w1 loop with the XLA
+    # ledger OFF vs ON with a live SloMonitor sampling at its default
+    # cadence — steady state the plane costs one counter bump per tracked
+    # call plus the background sampler (the AOT capture only fires on
+    # compile, which both loops exclude). Budget < 2%.
+    try:
+        from fedml_tpu.utils import xla_ledger
+        from fedml_tpu.utils.slo import SloMonitor
+
+        cfg_a = fedml_tpu.init(config={
+            "data_args": {"dataset": "mnist", "partition_method": "homo"},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 10, "client_num_per_round": 10,
+                "comm_round": 10, "epochs": 1, "batch_size": 10,
+                "learning_rate": 0.03,
+            },
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "sp"},
+        })
+        xla_ledger.set_enabled(False)
+        try:
+            sim_off = Simulator(cfg_a)
+            sim_off.run_round(0)  # compile
+            t0 = time.perf_counter()
+            for r in range(1, n + 1):
+                sim_off.run_round(r)
+            dt_off = time.perf_counter() - t0
+        finally:
+            xla_ledger.set_enabled(True)
+        mon = SloMonitor().start()
+        try:
+            sim_on = Simulator(cfg_a)
+            sim_on.run_round(0)  # compile (+ ledger AOT capture)
+            t0 = time.perf_counter()
+            for r in range(1, n + 1):
+                sim_on.run_round(r)
+            dt_on = time.perf_counter() - t0
+        finally:
+            mon.stop()
+        out["w1_attribution_overhead_pct"] = round(
+            max(dt_on / dt_off - 1.0, 0.0) * 100, 2)
+        out["w1_attribution_budget_pct"] = 2.0
+    except Exception as e:  # noqa: BLE001
+        out["w1_attribution_error"] = f"{type(e).__name__}: {e}"[:120]
+
     # round-block execution (ISSUE 1): this workload is where the host-
     # synchronous driver dominates (round program ≪ dispatch + device_get +
     # host scheduling), so K=8 blocks are the acceptance row — bar: ≥ 2×
@@ -2127,6 +2174,8 @@ _HEADLINE_KEYS = (
     "w1_mnist_lr_sp_rounds_per_sec", "w1_blocked_rounds_per_sec",
     "w1_blocked_speedup", "w1_telemetry_overhead_pct",
     "w1_health_overhead_pct",
+    # attribution plane (ISSUE 17): ledger + burn-rate monitor, budget <2%
+    "w1_attribution_overhead_pct",
     # chaos plane + reliable delivery (ISSUE 4): protocol-overhead row
     "w1_reliable_comm_overhead_pct",
     # wire codec plane (ISSUE 14): uplink payload reduction at accuracy

@@ -236,9 +236,13 @@ def cmd_report(args) -> int:
     """Telemetry report for a tracked run (reference: the MLOps run page;
     local-first: everything is already on disk). Reads the run's
     events JSONL (utils/sinks.JsonlSink) and prints a text summary —
-    per-span durations, metric-row counts, and the end-of-run counters/
-    histograms snapshot that mlops.finish appended — plus pointers to the
-    Chrome-trace artifact when present."""
+    per-span durations, the round-time budget table (transport share by
+    backend — ISSUE 17's attribution plane), SLO alert totals, metric-row
+    counts, and the end-of-run counters/histograms snapshot that
+    mlops.finish appended — plus pointers to the Chrome-trace artifact
+    when present. `--format json` emits the same facts as one stable
+    machine-readable object (schema key pins the shape); exit codes are
+    identical in both formats."""
     import os
 
     path = args.events
@@ -250,6 +254,7 @@ def cmd_report(args) -> int:
             return 1
 
     spans: dict = {}
+    span_rows: list = []
     n_metrics = n_sysperf = 0
     report_row = None
     with open(path) as f:
@@ -263,6 +268,7 @@ def cmd_report(args) -> int:
                                        {"count": 0, "total_s": 0.0})
                 agg["count"] += 1
                 agg["total_s"] += float(row.get("duration", 0.0))
+                span_rows.append(row)
             elif row.get("kind") == "metrics":
                 n_metrics += 1
                 if "sysperf" in row:
@@ -277,8 +283,59 @@ def cmd_report(args) -> int:
               "metrics (did it crash before the first round, or run with "
               "tracking disabled?)", file=sys.stderr)
         return 1
-    print(f"run events: {path}")
+
+    from .utils.attribution import attribute, render_table, \
+        rows_from_payloads
+
+    att = attribute(rows_from_payloads(span_rows))
+    snap = (report_row or {}).get("metrics", {})
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    gauges = snap.get("gauges", {})
+    dropped_total = int(counters.get("events.dropped_total", 0))
+    raw = sum(v for k, v in counters.items()
+              if k.startswith("comm.codec.") and k.endswith(".bytes_raw"))
+    wire = sum(v for k, v in counters.items()
+               if k.startswith("comm.codec.") and k.endswith(".bytes_wire"))
+    lg_req = counters.get("loadgen.requests", 0)
+    alerts_total = int(counters.get("slo.alerts_total", 0))
+    alerts = {k[len("slo.alerts."):]: int(v) for k, v in counters.items()
+              if k.startswith("slo.alerts.")}
+    burns = {k[len("slo.burn."):]: v for k, v in gauges.items()
+             if k.startswith("slo.burn.")}
     trace = path.replace(".events.jsonl", ".trace.json")
+
+    if getattr(args, "format", "text") == "json":
+        out = {
+            "schema": 1,
+            "events_path": path,
+            "trace_path": trace if os.path.exists(trace) else None,
+            "metric_rows": n_metrics,
+            "sysperf_rows": n_sysperf,
+            "spans": spans,
+            "budget": att,
+            "slo": {"alerts_total": alerts_total, "alerts": alerts,
+                    "burn": burns},
+            "dropped_spans_total": dropped_total,
+            "headline": {
+                "wire_codec_reduction": (raw / wire) if raw and wire
+                else None,
+                "loadgen_requests": int(lg_req) if lg_req else None,
+            },
+            "metrics": snap if report_row else None,
+        }
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+
+    print(f"run events: {path}")
+    if dropped_total:
+        # trace-loss visibility (ISSUE 17): a ring past its cap silently
+        # read as a short run before — now it reads as a truncated one
+        print(f"WARNING: trace TRUNCATED — {dropped_total} span/metric "
+              "rows dropped past the in-memory ring cap "
+              "(FEDML_TPU_EVENTS_CAP); the events JSONL keeps every row, "
+              "but the exported Chrome trace is missing the oldest spans",
+              file=sys.stderr)
     if os.path.exists(trace):
         print(f"chrome trace: {trace}  (open at ui.perfetto.dev)")
     print(f"metric rows: {n_metrics} ({n_sysperf} sysperf)")
@@ -290,22 +347,17 @@ def cmd_report(args) -> int:
             avg_ms = agg["total_s"] / agg["count"] * 1e3
             print(f"  {name:<{width}}  count={agg['count']:<8d} "
                   f"total={agg['total_s']:.3f}s  avg={avg_ms:.2f}ms")
+    if att.get("totals"):
+        print(render_table(att))
     if report_row:
-        counters = report_row.get("metrics", {}).get("counters", {})
         # wire codec plane (ISSUE 14): surface the payload-compression
         # ratio directly — summed over backends from the sender-side
         # `comm.codec.` byte counters
-        raw = sum(v for k, v in counters.items()
-                  if k.startswith("comm.codec.") and k.endswith(".bytes_raw"))
-        wire = sum(v for k, v in counters.items()
-                   if k.startswith("comm.codec.")
-                   and k.endswith(".bytes_wire"))
         if raw and wire:
             print(f"wire codec: {raw / wire:.1f}x payload reduction "
                   f"({_fmt_bytes(raw)} raw -> {_fmt_bytes(wire)} wire)")
         # live-loop soak (ISSUE 15): the closed-loop ledger — published
         # training rounds vs the loadgen's status taxonomy
-        lg_req = counters.get("loadgen.requests", 0)
         if lg_req:
             print(f"live loop: {int(lg_req)} requests — "
                   f"ok {int(counters.get('loadgen.ok', 0))}, "
@@ -313,11 +365,17 @@ def cmd_report(args) -> int:
                   f"err {int(counters.get('loadgen.errors', 0))}; "
                   f"{int(counters.get('soak.publishes', 0))} rounds "
                   "published to serving")
+        if alerts_total:
+            worst = max(burns.items(), key=lambda kv: kv[1],
+                        default=(None, 0.0))
+            print(f"slo alerts: {alerts_total} fired ("
+                  + ", ".join(f"{k} x{v}" for k, v in sorted(alerts.items()))
+                  + (f"); worst burn {worst[0]} {worst[1]:.1f}x"
+                     if worst[0] else ")"))
         if counters:
             print("counters:")
             for k in sorted(counters):
                 print(f"  {k} = {counters[k]}")
-        hists = report_row.get("metrics", {}).get("histograms", {})
         if hists:
             print("histograms:")
             for k in sorted(hists):
@@ -325,7 +383,6 @@ def cmd_report(args) -> int:
                 print(f"  {k}  count={h.get('count')} "
                       f"p50={h.get('p50')} p99={h.get('p99')} "
                       f"max={h.get('max')}")
-        gauges = report_row.get("metrics", {}).get("gauges", {})
         if gauges:
             print("gauges:")
             for k in sorted(gauges):
@@ -591,6 +648,36 @@ def _top_frame(snap: dict, source: str, prev: dict = None,
                 seg += f"  ttft_p99<={p99 * 1e3:.0f}ms"
         if "soak_slo_ok" in g:
             seg += "  slo " + ("OK" if g["soak_slo_ok"] else "VIOLATED")
+        lines.append(seg)
+
+    # -------------------------------------------- attribution (ISSUE 17)
+    # where the wall time went (fed.budget.* gauges from
+    # utils/attribution.py) + the live SLO burn/alert state (utils/slo.py)
+    if "fed_budget_wall_s" in g:
+        by_bk = {k[len("fed_budget_transport_"):-len("_s")]: v
+                 for k, v in g.items()
+                 if k.startswith("fed_budget_transport_")
+                 and k.endswith("_s") and k != "fed_budget_transport_s"}
+        seg = (f"budget: wall {g['fed_budget_wall_s']:.1f}s"
+               f"  transport {g.get('fed_budget_transport_share', 0):.0%}")
+        if by_bk:
+            seg += " (" + ", ".join(
+                f"{b} {v:.1f}s" for b, v in sorted(by_bk.items())) + ")"
+        seg += (f"  compute {g.get('fed_budget_compute_s', 0):.1f}s"
+                f"  ingest {g.get('fed_budget_ingest_s', 0):.1f}s"
+                f"  agg {g.get('fed_budget_agg_s', 0):.1f}s"
+                f"  idle {g.get('fed_budget_idle_s', 0):.1f}s")
+        lines.append(seg)
+    if "slo_alerts_firing" in g or c.get("slo_alerts_total"):
+        burns = {k[len("slo_burn_"):]: v for k, v in g.items()
+                 if k.startswith("slo_burn_") and not k.endswith("_slow")}
+        seg = (f"alerts: firing {int(g.get('slo_alerts_firing', 0))}"
+               f"  fired_total {int(c.get('slo_alerts_total', 0))}")
+        if burns:
+            worst = max(burns.items(), key=lambda kv: kv[1])
+            seg += "  burn " + " ".join(
+                f"{k}:{v:.1f}x" for k, v in sorted(burns.items()))
+            seg += f"  worst {worst[0]}"
         lines.append(seg)
 
     # ------------------------------------------------------------- retraces
@@ -1537,6 +1624,111 @@ def cmd_diagnosis(args) -> int:
                 "kills": rep["kills_executed"],
                 "elapsed_s": round(dt, 1)}
 
+    def attribution_smoke():
+        # the attribution plane end-to-end (ISSUE 17): a tiny tracked
+        # round program + loopback comm traffic + a small decode engine,
+        # then all three legs checked — the XLA ledger's KV-pool bytes
+        # agree with the engine's own serving.kv_bytes_per_slot math
+        # within 1%, the round-time budget renders with transport share
+        # > 0, and a forced error burst fires the fast-burn SLO alert —
+        # inside a ~20s budget.
+        import os as _os
+        import time as _t
+
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from .comm.manager import FedCommManager, create_transport
+        from .comm.message import Message
+        from .serving.engine import DecodeEngine
+        from .llm.transformer import TransformerLM
+        from .utils import metrics as mx
+        from .utils import xla_ledger
+        from .utils.attribution import attribute, render_table, \
+            rows_from_recorder
+        from .utils.events import recorder
+        from .utils.slo import SloMonitor, default_specs
+
+        t0 = _t.perf_counter()
+        # leg a: a tracked program the ledger must capture, inside a
+        # round-tagged span so the budget gets a round window
+        f = mx.track_jit(_jax.jit(lambda a, b: a @ b), "probe_matmul")
+        with recorder.span("train", round=0):
+            x = _jnp.ones((64, 64))
+            f(x, x).block_until_ready()
+        prog = xla_ledger.programs().get("probe_matmul", {})
+        if not prog.get("flops"):
+            raise ValueError(
+                f"xla ledger captured no cost analysis: {prog!r}")
+        # comm traffic -> transport share; loopback manager stamps
+        # backend meta on the send/handle spans
+        run = f"diag-attr-{_os.getpid()}"
+        a = FedCommManager(create_transport("loopback", 0, run), rank=0)
+        b = FedCommManager(create_transport("loopback", 1, run), rank=1)
+        got = []
+        b.register_message_receive_handler(
+            "probe", lambda m: got.append(m))
+        b.run(background=True)
+        for _ in range(3):
+            a.send_message(Message("probe", 0, 1))
+        deadline = _t.monotonic() + 5
+        while len(got) < 3 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        a.stop()
+        b.stop()
+        if len(got) != 3:
+            raise RuntimeError(f"loopback delivered {len(got)}/3")
+        # leg a (memory): engine HBM ledger vs the engine's own math
+        model = TransformerLM(vocab_size=32, d_model=16, n_layers=1,
+                              n_heads=2, d_ff=32, scan_layers=True)
+        params = model.init(_jax.random.key(0),
+                            _jnp.zeros((1, 8), _jnp.int32))["params"]
+        eng = DecodeEngine(model, params, n_slots=2, max_len=32).start()
+        try:
+            eng.submit([1, 2, 3], 4).result(timeout=30)
+        finally:
+            eng.stop()
+        ledger_kv = xla_ledger.buffers().get("kv_pool", 0)
+        engine_kv = 2 * mx.registry.gauge(
+            "serving.kv_bytes_per_slot").value()
+        if not engine_kv or abs(ledger_kv - engine_kv) / engine_kv > 0.01:
+            raise ValueError(
+                f"KV ledger disagrees with the engine: ledger {ledger_kv} "
+                f"vs engine {engine_kv} (must agree within 1%)")
+        # leg b: budget renders, transport was in flight
+        att = attribute(rows_from_recorder())
+        table = render_table(att)
+        share = att["totals"]["transport_share"]
+        if "transport%" not in table or share <= 0:
+            raise ValueError(
+                f"budget table missing transport share: {share} "
+                f"(table: {table.splitlines()[0]!r})")
+        # leg c: a forced error burst must fire the fast-burn alert —
+        # private registry + injected clock, so the burst is deterministic
+        reg = mx.MetricsRegistry()
+        clock = [0.0]
+        mon = SloMonitor(default_specs(), fast_window_s=5.0,
+                         time_fn=lambda: clock[0], registry=reg)
+        reg.counter("loadgen.ok").inc(100)
+        mon.sample()
+        clock[0] = 1.0
+        reg.counter("loadgen.errors").inc(50)
+        mon.sample()
+        if "availability.fast" not in mon.firing():
+            raise ValueError(
+                f"forced error burst did not fire the fast-burn alert: "
+                f"firing={mon.firing()}")
+        dt = _t.perf_counter() - t0
+        if dt > 20:
+            raise RuntimeError(
+                f"attribution smoke took {dt:.1f}s (budget 20s)")
+        return {"program_flops": prog.get("flops"),
+                "kv_ledger_bytes": ledger_kv,
+                "kv_engine_bytes": engine_kv,
+                "transport_share": share,
+                "alerts_firing": mon.firing(),
+                "elapsed_s": round(dt, 1)}
+
     probes = {"jax": jax_devices, "wire_codec": wire,
               "loopback_transport": loopback, "grpc_transport": grpc,
               "native_lib": native, "metrics_endpoint": metrics_endpoint,
@@ -1550,6 +1742,7 @@ def cmd_diagnosis(args) -> int:
               "cohort_sharded_smoke": cohort_sharded_smoke,
               "cross_silo_durability_smoke": cross_silo_durability_smoke,
               "live_loop_smoke": live_loop_smoke,
+              "attribution_smoke": attribution_smoke,
               "lint_clean": lint_clean}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "codec_smoke",
@@ -1558,7 +1751,7 @@ def cmd_diagnosis(args) -> int:
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
                 "cross_silo_durability_smoke", "live_loop_smoke",
-                "lint_clean")
+                "attribution_smoke", "lint_clean")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)
@@ -1631,6 +1824,10 @@ def main(argv=None) -> int:
                          "--log-dir/--run)")
     rp.add_argument("--log-dir", default="./log")
     rp.add_argument("--run", default=None, help="run-name prefix filter")
+    rp.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json emits one stable machine-readable object "
+                         "(budget table, SLO/alert summary, metrics "
+                         "snapshot) for CI/autoscaler consumption")
     tp = sub.add_parser("top",
                         help="live one-screen run health from a /metrics "
                              "endpoint (or a finished run's events file)")

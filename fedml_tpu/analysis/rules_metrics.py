@@ -2,7 +2,9 @@
 
 The repo's observability contract is stringly typed: `utils/metrics.py`
 instruments by dotted name (`fed.*` / `serving.*` / `comm.*` / `xla.*`,
-plus the live-loop soak's `soak.*` / `loadgen.*` — ISSUE 15),
+the live-loop soak's `soak.*` / `loadgen.*` — ISSUE 15 — and the
+attribution plane's `slo.*` burn-rate alerts + `events.*` trace-drop
+counters — ISSUE 17),
 `utils/prometheus.py` sanitizes those to exposition names
 (`fed_rounds_total`), and the `top` verb + README document them back to
 operators. Nothing ties the three together — a typo'd emit or a renamed
@@ -37,13 +39,14 @@ from .core import (
     edit_distance,
 )
 
-_FAMILIES = ("fed", "serving", "comm", "xla", "soak", "loadgen")
+_FAMILIES = ("fed", "serving", "comm", "xla", "soak", "loadgen", "slo",
+             "events")
 _RAW_RE = re.compile(
-    r"^(?:fed|serving|comm|xla|soak|loadgen)\.[a-z0-9_.]*$")
+    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events)\.[a-z0-9_.]*$")
 _SAN_RE = re.compile(
-    r"^(?:fed|serving|comm|xla|soak|loadgen)_[a-z0-9_]+$")
+    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events)_[a-z0-9_]+$")
 _DOC_RE = re.compile(
-    r"`((?:fed|serving|comm|xla|soak|loadgen)\.[^`\s]+)`")
+    r"`((?:fed|serving|comm|xla|soak|loadgen|slo|events)\.[^`\s]+)`")
 _INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 
 # method name -> instrument kind

@@ -27,9 +27,25 @@ from .loopback import LoopbackTransport
 from .message import Message
 
 
+def _backend_of(transport: BaseTransport) -> str:
+    """Innermost transport's backend tag (unwraps the reliability/chaos
+    stack): "grpc", "loopback", "broker", ... — stamped into comm span
+    meta so the attribution plane can break transport time out by
+    backend (utils/attribution.py)."""
+    t = transport
+    while hasattr(t, "inner"):
+        t = t.inner
+    name = type(t).__name__.lower()
+    for tag in ("grpc", "loopback", "broker"):
+        if tag in name:
+            return tag
+    return name.removesuffix("transport") or name
+
+
 class FedCommManager(Observer):
     def __init__(self, transport: BaseTransport, rank: int = 0):
         self.transport = transport
+        self.backend = _backend_of(transport)
         self.rank = rank
         self._handlers: dict[str, Callable[[Message], None]] = {}
         self.transport.add_observer(self)
@@ -49,7 +65,7 @@ class FedCommManager(Observer):
         # _encode_frame stamps it into the headers, so the receiver's
         # handle span stitches to this one.
         with recorder.span(f"comm.send.{msg.type}", sender=msg.sender_id,
-                           receiver=msg.receiver_id):
+                           receiver=msg.receiver_id, backend=self.backend):
             self.transport.send_message(msg)
 
     def receive_message(self, msg_type: str, msg: Message) -> None:
@@ -73,7 +89,8 @@ class FedCommManager(Observer):
         with trace_context(tid, parent):
             with recorder.span(f"comm.handle.{msg_type}",
                                sender=msg.sender_id,
-                               receiver=msg.receiver_id):
+                               receiver=msg.receiver_id,
+                               backend=self.backend):
                 handler(msg)
 
     def run(self, background: bool = False) -> None:

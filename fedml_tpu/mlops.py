@@ -206,6 +206,17 @@ def export_chrome_trace(path: str) -> str:
 def _finish_report() -> None:
     """End-of-run summary → sinks (the reference posts a run-summary row at
     release), plus the Chrome-trace artifact for tracked runs."""
+    # attribution plane (ISSUE 17): land measured MFU (span wall over
+    # cost-analysis FLOPs) and the round-time budget as gauges BEFORE the
+    # snapshot below, so the report row and Prometheus both carry them
+    try:
+        from .utils import attribution, xla_ledger
+
+        xla_ledger.measured_mfu()
+        attribution.analyze_and_publish()
+    except Exception as e:  # noqa: BLE001 — attribution must not block exit
+        logging.getLogger(__name__).warning(
+            "attribution publish failed: %s: %s", type(e).__name__, e)
     # gate on recorder.sinks, not _state["sinks"]: fedml_tpu.init attaches
     # the config sinks itself, so this run's JsonlSink may predate mlops.init
     if recorder.sinks:

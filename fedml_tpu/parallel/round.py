@@ -566,7 +566,9 @@ def build_round_fn(
     # donate server/client/hook state: all three are dead after the call, and
     # the hook state can be a [N, D] defense history that must update in place.
     # track_jit keeps PR 1's retrace guard on as a metric: gauge
-    # xla.compiles.round_fn / counter xla.retraces.round_fn.
+    # xla.compiles.round_fn / counter xla.retraces.round_fn — and, on each
+    # compile, captures the program's cost/memory analysis into the XLA
+    # ledger (xla.program.*.round_fn — utils/xla_ledger.py, ISSUE 17).
     return track_jit(jax.jit(round_body, donate_argnums=(0, 1, 6)),
                      "round_fn")
 

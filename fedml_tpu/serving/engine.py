@@ -158,6 +158,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import metrics as _mx
+from ..utils import xla_ledger as _ledger
 from ..utils.events import recorder
 from .predictor import InvalidRequest, _bucket
 
@@ -852,14 +853,20 @@ class DecodeEngine:
         # would silently turn the in-place update into a full copy.
         self._spec_jit = None
         self._admit_many_jit = None
+        # track_jit: retrace telemetry + the XLA cost/memory ledger — each
+        # program's cost_analysis/memory_analysis lands in xla.program.*
+        # gauges on first compile (utils/xla_ledger.py)
         if mesh is None:
-            self._admit_jit = jax.jit(_admit, donate_argnums=(2,))
-            self._step_jit = jax.jit(_step_all, donate_argnums=(2,))
+            self._admit_jit = _mx.track_jit(
+                jax.jit(_admit, donate_argnums=(2,)), "engine_admit")
+            self._step_jit = _mx.track_jit(
+                jax.jit(_step_all, donate_argnums=(2,)), "engine_step")
             if self._spec_on:
-                self._spec_jit = jax.jit(_spec_all, donate_argnums=(2,))
+                self._spec_jit = _mx.track_jit(
+                    jax.jit(_spec_all, donate_argnums=(2,)), "engine_spec")
             if self._paged and self._admit_batch > 1:
-                self._admit_many_jit = jax.jit(
-                    _admit_many, donate_argnums=(2,))
+                self._admit_many_jit = _mx.track_jit(jax.jit(
+                    _admit_many, donate_argnums=(2,)), "engine_admit_many")
             carry_sh = None
         else:
             # ONE carry-layout dict, used for the jit out_shardings AND the
@@ -881,21 +888,24 @@ class DecodeEngine:
                 carry_sh["pages"] = rep_sharding
             if self._spec_on:
                 carry_sh["hist"] = rep_sharding
-            self._admit_jit = jax.jit(
+            self._admit_jit = _mx.track_jit(jax.jit(
                 _admit, donate_argnums=(2,),
-                out_shardings=(carry_sh, rep_sharding))
-            self._step_jit = jax.jit(
+                out_shardings=(carry_sh, rep_sharding)), "engine_admit")
+            self._step_jit = _mx.track_jit(jax.jit(
                 _step_all, donate_argnums=(2,),
-                out_shardings=(carry_sh, (rep_sharding, rep_sharding)))
+                out_shardings=(carry_sh, (rep_sharding, rep_sharding))),
+                "engine_step")
             if self._spec_on:
-                self._spec_jit = jax.jit(
+                self._spec_jit = _mx.track_jit(jax.jit(
                     _spec_all, donate_argnums=(2,),
                     out_shardings=(carry_sh,
-                                   (rep_sharding, rep_sharding)))
+                                   (rep_sharding, rep_sharding))),
+                    "engine_spec")
             if self._paged and self._admit_batch > 1:
-                self._admit_many_jit = jax.jit(
+                self._admit_many_jit = _mx.track_jit(jax.jit(
                     _admit_many, donate_argnums=(2,),
-                    out_shardings=(carry_sh, rep_sharding))
+                    out_shardings=(carry_sh, rep_sharding)),
+                    "engine_admit_many")
 
         head = model.d_model // model.n_heads
         if self._paged:
@@ -941,6 +951,15 @@ class DecodeEngine:
             # call donates it back in the same layout
             self._carry = jax.tree.map(
                 lambda a, s: jax.device_put(a, s), self._carry, carry_sh)
+
+        # device-memory ledger: the engine's three resident pytrees. The
+        # kv_pool entry must agree with the kv_bytes_per_slot math above
+        # within 1% (pinned in tests) — they sum the same buffers
+        _ledger.register_buffers("serving_params", self.params)
+        _ledger.register_buffers("kv_pool", self._carry["cache"])
+        _ledger.register_buffers("engine_carry",
+                                 {k: v for k, v in self._carry.items()
+                                  if k != "cache"})
 
         self._cond = threading.Condition()
         self._waiting: deque[_Request] = deque()

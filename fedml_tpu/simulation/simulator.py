@@ -317,6 +317,13 @@ class Simulator:
         self._eval = track_jit(
             make_eval_fn(self.apply_fn, t.extra.get("task"),
                          self.num_classes), "eval_fn")
+        # device-memory ledger (ISSUE 17): the simulator's resident trees
+        # — global params and the per-client optimizer/state stack — so
+        # `report`'s xla.ledger.* rows account for training HBM too
+        from ..utils import xla_ledger as _ledger
+
+        _ledger.register_buffers("fed_params", self.params)
+        _ledger.register_buffers("client_states", self.client_states)
         self.history: list[dict] = []
 
     # reference parity: sampling seeded by round index (fedavg_api.py:127-135

@@ -40,6 +40,13 @@ SOAK_KNOBS = {
     "shed_frac_max":   {"kind": "frac", "consumer": "plan"},
     "ttft_p99_slo_ms": {"kind": "num", "strict": True, "consumer": "plan"},
     "lag_rounds_max":  {"kind": "int", "min": 0, "consumer": "plan"},
+    # live burn-rate alerting (ISSUE 17, utils/slo.py): the error budget
+    # and the multi-window thresholds the SloMonitor pages on
+    "slo_error_budget":  {"kind": "frac", "consumer": "plan"},
+    "slo_fast_window_s": {"kind": "num", "strict": True, "consumer": "plan"},
+    "slo_slow_window_s": {"kind": "num", "strict": True, "consumer": "plan"},
+    "slo_fast_burn":     {"kind": "num", "strict": True, "consumer": "plan"},
+    "slo_slow_burn":     {"kind": "num", "strict": True, "consumer": "plan"},
 }
 
 
@@ -131,5 +138,10 @@ def soak_plan(sk: dict) -> dict:
             "shed_frac_max": float(sk.get("shed_frac_max", 0.2)),
             "ttft_p99_slo_ms": float(sk.get("ttft_p99_slo_ms", 2000.0)),
             "lag_rounds_max": int(sk.get("lag_rounds_max", 2)),
+            "slo_error_budget": float(sk.get("slo_error_budget", 0.01)),
+            "slo_fast_window_s": float(sk.get("slo_fast_window_s", 5.0)),
+            "slo_slow_window_s": float(sk.get("slo_slow_window_s", 30.0)),
+            "slo_fast_burn": float(sk.get("slo_fast_burn", 5.0)),
+            "slo_slow_burn": float(sk.get("slo_slow_burn", 1.0)),
         },
     }

@@ -348,6 +348,8 @@ class LiveLoopHarness:
         coverage of the final fleet (short training runs otherwise leave
         a thin request sample). Returns the report dict
         (slo.evaluate_slo output + loop facts)."""
+        from ..utils.attribution import analyze_and_publish
+        from ..utils.slo import SloMonitor, default_specs
         from .loadgen import LoadGenerator
         from .slo import evaluate_slo
 
@@ -364,6 +366,14 @@ class LiveLoopHarness:
         # everywhere else too)
         self.silo.wait_history(1, timeout=120)
         gen = LoadGenerator(self.traffic, self.url).start()
+        # live burn-rate watch over the SAME bars evaluate_slo judges
+        # post hoc (ISSUE 17, utils/slo.py): starts with traffic, so a
+        # run trending red alerts while it is still running
+        slo_mon = SloMonitor(
+            default_specs(self.slo),
+            fast_window_s=float(self.slo.get("slo_fast_window_s", 5.0)),
+            slow_window_s=float(self.slo.get("slo_slow_window_s", 30.0)),
+        ).start()
         kills = dict(self.fault_spec.silo_kill) if self.fault_spec else {}
         pending = sorted(kills.items(), key=lambda kv: (kv[1], kv[0]))
         executed = []
@@ -411,6 +421,10 @@ class LiveLoopHarness:
                               adapter_name(self._swapped_round),
                               self._swapped_round + 1)
         results = gen.stop(timeout=60)
+        slo_mon.stop()
+        # round-time budget over the run's spans -> fed.budget.* gauges
+        # (the report/top `budget:` line)
+        analyze_and_publish(wall_s=wall_train)
         report = evaluate_slo(
             results, rounds_done=len(srv.history) if srv else 0,
             wall_s=wall_train,
@@ -424,7 +438,8 @@ class LiveLoopHarness:
             kills_executed=executed,
             kills_pending=pending,
             history=[dict(h) for h in (srv.history if srv else [])],
-            fleet_versions=self.dep.versions())
+            fleet_versions=self.dep.versions(),
+            slo_alerts_firing=slo_mon.firing())
         report["loop_ok"] = bool(
             report["slo_ok"] and train_done and not report["train_error"]
             and report["converged"] and not pending)

@@ -17,7 +17,14 @@ Beyond the reference (ISSUE 2):
 - spans/metrics live in bounded ring buffers (default 100k rows,
   FEDML_TPU_EVENTS_CAP overrides) so week-long runs don't grow without
   bound; `summary()` keeps EXACT counts in an aggregate dict that survives
-  ring eviction.
+  ring eviction;
+- eviction is NOT silent (ISSUE 17): every span pushed out past the cap is
+  counted per track (`events.dropped.<track>` + `events.dropped_total`
+  counters, mirrored in `recorder.dropped`), and `export_chrome_trace`
+  warns loudly — a trace that quietly lost its oldest 30k spans reads as
+  a short run, not a truncated one. Sinks see every row regardless (the
+  JSONL file is unbounded; only the in-memory rings and the Chrome trace
+  exported from them are capped).
 """
 from __future__ import annotations
 
@@ -143,6 +150,11 @@ class EventRecorder:
         self.spans: _Ring = _Ring(maxlen=max_rows)
         self.metrics: _Ring = _Ring(maxlen=max_rows)
         self.sinks: list[Callable[[str, dict], None]] = []
+        # spans evicted past the cap, by Chrome-trace track, plus evicted
+        # metric rows — the trace-truncation ledger (`summary()` stays
+        # exact regardless; this says how much of the RING is gone)
+        self.dropped: dict[str, int] = {t: 0 for t in self._TRACKS}
+        self.dropped_rows = 0
         self._agg: dict[str, dict] = {}
         # guards the agg dict AND buffer append/snapshot pairs: deque
         # iteration raises RuntimeError if another thread appends mid-walk,
@@ -165,13 +177,31 @@ class EventRecorder:
 
     def _record(self, s: Span) -> None:
         with self._agg_lock:
+            if self.spans.maxlen is not None \
+                    and len(self.spans) == self.spans.maxlen:
+                track = self._track_of(self.spans[0].name)
+                self.dropped[track] += 1
+                dropped = True
+            else:
+                dropped = False
             self.spans.append(s)
             agg = self._agg.setdefault(s.name, {"count": 0, "total_s": 0.0})
             agg["count"] += 1
             agg["total_s"] += s.duration
+        if dropped:
+            # outside the agg lock: the metrics registry has its own
+            # locking and must not nest under ours
+            from . import metrics as _mx
+
+            _mx.inc(f"events.dropped.{track}")
+            _mx.inc("events.dropped_total")
 
     def _sink_payload(self, s: Span) -> dict:
+        # "t" (wall-clock start) makes sink rows orderable and lets the
+        # attribution plane (utils/attribution.py) rebuild the timeline
+        # from a finished run's events JSONL
         out = {"name": s.name, "duration": s.duration,
+               "t": round(self._epoch + s.start, 6),
                "trace_id": s.trace_id, "span_id": s.span_id}
         if s.parent_id:
             out["parent_id"] = s.parent_id
@@ -227,7 +257,15 @@ class EventRecorder:
 
     def log(self, metrics: dict):
         with self._agg_lock:
+            dropped = (self.metrics.maxlen is not None
+                       and len(self.metrics) == self.metrics.maxlen)
+            if dropped:
+                self.dropped_rows += 1
             self.metrics.append(metrics)
+        if dropped:
+            from . import metrics as _mx
+
+            _mx.inc("events.dropped_total")
         for sink in self.sinks:
             sink("metrics", metrics)
 
@@ -274,11 +312,27 @@ class EventRecorder:
         round spans land on separately named threads of one process (via
         "M" thread_name metadata events); `args` carries each span's meta
         plus its trace_id/span_id/parent_id so a stitched cross-silo trace
-        is searchable by id."""
+        is searchable by id.
+
+        A trace exported after ring eviction is TRUNCATED — the oldest
+        spans are gone. That is surfaced loudly: a warning log with the
+        per-track drop counts, and the same counts in the process metadata
+        event's args (visible in the Perfetto process details)."""
+        dropped = {t: n for t, n in self.dropped.items() if n}
+        if dropped:
+            logger.warning(
+                "chrome trace is TRUNCATED: %d spans were dropped past the "
+                "ring cap (%s) before this export — the oldest part of the "
+                "run is missing; raise FEDML_TPU_EVENTS_CAP to keep more",
+                sum(dropped.values()),
+                ", ".join(f"{t}: {n}" for t, n in sorted(dropped.items())))
         tids = {t: i for i, t in enumerate(self._TRACKS)}
+        meta_args: dict = {"name": "fedml_tpu"}
+        if dropped:
+            meta_args["dropped_spans"] = dict(sorted(dropped.items()))
         events: list[dict] = [{"ph": "M", "pid": 0, "tid": 0,
                                "name": "process_name",
-                               "args": {"name": "fedml_tpu"}}]
+                               "args": meta_args}]
         for t, i in tids.items():
             events.append({"ph": "M", "pid": 0, "tid": i,
                            "name": "thread_name", "args": {"name": t}})

@@ -340,6 +340,12 @@ class _TrackedJit:
     counts growth beyond the first entry as counter `xla.retraces.<name>`
     (a warm steady state is exactly one cache entry; every extra entry is a
     shape/dtype/weak-type retrace paying a fresh XLA compile).
+
+    Each call also bumps the `xla.program.calls.<name>` counter, and a
+    cache growth hands the call's abstract signature to the XLA ledger
+    (utils/xla_ledger.py) so the freshly compiled program's
+    cost_analysis/memory_analysis land as `xla.program.*` gauges — capture
+    happens at compile events only, never on the steady-state path.
     Attribute access (lower, _cache_size, ...) passes through."""
 
     def __init__(self, fn, name: str):
@@ -353,12 +359,16 @@ class _TrackedJit:
             size = self._fn._cache_size()
         except Exception:  # jax version without the introspection hook
             return out
+        from . import xla_ledger
+
+        xla_ledger.note_call(self._name)
         if size > self._seen:
             if self._seen >= 1:
                 registry.counter(
                     f"xla.retraces.{self._name}").inc(size - self._seen)
             self._seen = size
             registry.gauge(f"xla.compiles.{self._name}").set(size)
+            xla_ledger.capture(self._name, self._fn, args, kwargs)
         return out
 
     def __getattr__(self, item):

@@ -39,6 +39,8 @@ def _isolate_recorder():
     spans, metrics = list(recorder.spans), list(recorder.metrics)
     sinks = list(recorder.sinks)
     agg = {k: dict(v) for k, v in recorder.summary().items()}
+    dropped = dict(recorder.dropped)
+    dropped_rows = recorder.dropped_rows
     yield
     recorder.spans.clear()
     recorder.spans.extend(spans)
@@ -48,6 +50,28 @@ def _isolate_recorder():
     with recorder._agg_lock:
         recorder._agg.clear()
         recorder._agg.update(agg)
+        recorder.dropped.clear()
+        recorder.dropped.update(dropped)
+        recorder.dropped_rows = dropped_rows
+
+
+@pytest.fixture(autouse=True)
+def _isolate_xla_ledger():
+    """The XLA cost/memory ledger (utils/xla_ledger.py, ISSUE 17) keeps
+    process-global program/buffer dicts; snapshot and restore them so one
+    test's captures can't satisfy another's assertions."""
+    from fedml_tpu.utils import xla_ledger
+
+    progs = xla_ledger.programs()
+    bufs = xla_ledger.buffers()
+    enabled = xla_ledger.enabled()
+    yield
+    with xla_ledger._lock:
+        xla_ledger._programs.clear()
+        xla_ledger._programs.update(progs)
+        xla_ledger._buffers.clear()
+        xla_ledger._buffers.update(bufs)
+    xla_ledger.set_enabled(enabled)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,17 @@
+"""graftlint fixture: ISSUE 17 consumer surfaces (a miniature `top`
+alerts line + a report-style raw snapshot read). Never imported —
+parsed by the linter only."""
+
+
+def _top_frame(snap):
+    c, g = snap["counters"], snap["gauges"]
+    fired = c.get("slo_alerts_total", 0)
+    burns = {k: v for k, v in g.items() if k.startswith("slo_burn_")}
+    ghost = g.get("slo_budget_remaining", 0)       # FINDING: never emitted
+    return fired, burns, ghost
+
+
+def report(snap):
+    dropped = snap["counters"].get("events.dropped_total", 0)
+    stale = snap["counters"].get("events.evicted_total", 0)  # FINDING: never emitted
+    return dropped, stale

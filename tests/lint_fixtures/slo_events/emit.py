@@ -1,0 +1,31 @@
+"""graftlint fixture: metric-registry coverage of the ISSUE 17 families
+(`slo.*` burn/alert series, `events.*` drop counters, `xla.program.*`
+ledger gauges). Never imported — parsed by the linter only."""
+from utils import metrics as mx
+
+
+def burn(name, v):
+    mx.set_gauge(f"slo.burn.{name}", v)          # prefix emit
+
+
+def alert(name):
+    mx.inc("slo.alerts_total")
+    mx.inc(f"slo.alerts.{name}")
+
+
+def alert_typo():
+    mx.inc("slo.alert_total")                    # FINDING: 1 edit from established
+
+
+def drops(track):
+    mx.inc(f"events.dropped.{track}")
+    mx.inc("events.dropped_total")
+
+
+def ledger(prog, flops):
+    mx.set_gauge(f"xla.program.flops.{prog}", flops)
+
+
+def alert_span(recorder):
+    with recorder.span("slo.alert", slo="availability"):
+        pass

@@ -202,6 +202,27 @@ def test_metric_registry_fixture():
     assert "no emit site produces it" in msgs
 
 
+def test_metric_registry_slo_events_families():
+    """The attribution plane's families (ISSUE 17): `slo.*` / `events.*`
+    names are first-class to the rule — f-string prefix emits
+    (`slo.burn.<name>`, `events.dropped.<track>`) satisfy prefix reads,
+    a near-miss `slo.alert_total` typo and ghost consumer reads
+    (`slo_budget_remaining`, `events.evicted_total`) all surface —
+    while reads landing UNDER a prefix emit (`slo_burn_*`) don't."""
+    findings, _stats = _lint_fixture("slo_events", "metric-registry")
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(os.path.basename(f.path), set()).add(f.line)
+    assert by_path.pop("emit.py") == _marked_lines("slo_events", "emit.py")
+    assert by_path.pop("__main__.py") == _marked_lines("slo_events",
+                                                       "__main__.py")
+    assert not by_path
+    msgs = " ".join(f.message for f in findings)
+    assert "slo.alert_total" in msgs and "slo.alerts_total" in msgs
+    assert "slo_budget_remaining" in msgs
+    assert "events.evicted_total" in msgs
+
+
 def test_metric_registry_spans_do_not_satisfy_scrape_reads():
     # a span name must NOT satisfy a `top`/snapshot consumer — spans never
     # reach /metrics. The doc surface (where span names are legitimate)
