@@ -405,6 +405,67 @@ def bench_workload1_mnist_lr() -> dict:
     except Exception as e:  # noqa: BLE001
         out["w1_attribution_error"] = f"{type(e).__name__}: {e}"[:120]
 
+    # fleet-observability overhead (ISSUE 18): the SAME w1 loop with the
+    # whole fleet plane ON — flight recorder armed (ring appends + spill
+    # cadence), a FleetCollector scraping this process's own /metrics
+    # exporter on a fast cadence, per-link comm telemetry enabled — vs
+    # all of it OFF. The plane is bounded deque appends plus a background
+    # scraper thread; budget < 2%.
+    try:
+        import tempfile
+
+        from fedml_tpu.comm import base as comm_base
+        from fedml_tpu.utils import postmortem
+        from fedml_tpu.utils.obsfleet import FleetCollector
+        from fedml_tpu.utils.prometheus import MetricsExporter
+
+        cfg_f = fedml_tpu.init(config={
+            "data_args": {"dataset": "mnist", "partition_method": "homo"},
+            "model_args": {"model": "lr"},
+            "train_args": {
+                "federated_optimizer": "FedAvg",
+                "client_num_in_total": 10, "client_num_per_round": 10,
+                "comm_round": 10, "epochs": 1, "batch_size": 10,
+                "learning_rate": 0.03,
+            },
+            "validation_args": {"frequency_of_the_test": 0},
+            "comm_args": {"backend": "sp"},
+        })
+        comm_base.set_link_telemetry(False)
+        postmortem.flight.set_enabled(False)
+        try:
+            sim_foff = Simulator(cfg_f)
+            sim_foff.run_round(0)  # compile
+            t0 = time.perf_counter()
+            for r in range(1, n + 1):
+                sim_foff.run_round(r)
+            dt_foff = time.perf_counter() - t0
+        finally:
+            comm_base.set_link_telemetry(True)
+            postmortem.flight.set_enabled(True)
+        with tempfile.TemporaryDirectory() as td:
+            postmortem.flight.arm(td, process="bench-w1",
+                                  install_handlers=False)
+            exp = MetricsExporter(port=0).start()
+            coll = FleetCollector({"bench-w1": exp.url},
+                                  interval_s=0.2).start()
+            try:
+                sim_fon = Simulator(cfg_f)
+                sim_fon.run_round(0)  # compile
+                t0 = time.perf_counter()
+                for r in range(1, n + 1):
+                    sim_fon.run_round(r)
+                dt_fon = time.perf_counter() - t0
+            finally:
+                coll.stop()
+                exp.stop()
+                postmortem.flight.disarm()
+        out["w1_fleet_obs_overhead_pct"] = round(
+            max(dt_fon / dt_foff - 1.0, 0.0) * 100, 2)
+        out["w1_fleet_obs_budget_pct"] = 2.0
+    except Exception as e:  # noqa: BLE001
+        out["w1_fleet_obs_error"] = f"{type(e).__name__}: {e}"[:120]
+
     # round-block execution (ISSUE 1): this workload is where the host-
     # synchronous driver dominates (round program ≪ dispatch + device_get +
     # host scheduling), so K=8 blocks are the acceptance row — bar: ≥ 2×
@@ -2176,6 +2237,9 @@ _HEADLINE_KEYS = (
     "w1_health_overhead_pct",
     # attribution plane (ISSUE 17): ledger + burn-rate monitor, budget <2%
     "w1_attribution_overhead_pct",
+    # fleet observability (ISSUE 18): flight recorder + self-scrape +
+    # per-link telemetry, budget <2%
+    "w1_fleet_obs_overhead_pct",
     # chaos plane + reliable delivery (ISSUE 4): protocol-overhead row
     "w1_reliable_comm_overhead_pct",
     # wire codec plane (ISSUE 14): uplink payload reduction at accuracy

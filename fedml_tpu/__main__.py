@@ -242,14 +242,38 @@ def cmd_report(args) -> int:
     mlops.finish appended — plus pointers to the Chrome-trace artifact
     when present. `--format json` emits the same facts as one stable
     machine-readable object (schema key pins the shape); exit codes are
-    identical in both formats."""
+    identical in both formats. `--merge run_dirA run_dirB ...` switches to
+    trace federation (ISSUE 18): N processes' Chrome traces folded into one
+    clock-corrected Perfetto timeline. `--fleet URL` folds a live
+    FleetCollector's snapshot (per-process columns, fleet sums, staleness
+    marks) into the report."""
     import os
+
+    if getattr(args, "merge", None):
+        return _report_merge(args)
+
+    fleet = None
+    if getattr(args, "fleet", None):
+        try:
+            fleet = _fetch_fleet(args.fleet)
+        except Exception as e:  # noqa: BLE001 — operator-facing CLI
+            print(f"fleet fetch failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
 
     path = args.events
     if path is None:
         try:
             path = _newest_events_file(args.log_dir, args.run)
         except FileNotFoundError as e:
+            if fleet is not None:
+                # fleet-only report: a live fleet needs no local run dir
+                if getattr(args, "format", "text") == "json":
+                    print(json.dumps({"schema": 2, "fleet": fleet},
+                                     indent=2, sort_keys=True))
+                else:
+                    print(_render_fleet(fleet))
+                return 0
             print(str(e), file=sys.stderr)
             return 1
 
@@ -284,8 +308,9 @@ def cmd_report(args) -> int:
               "tracking disabled?)", file=sys.stderr)
         return 1
 
-    from .utils.attribution import attribute, render_table, \
-        rows_from_payloads
+    from .utils.attribution import attribute, link_table, \
+        render_link_table, render_table, rows_from_payloads
+    from .utils.postmortem import load_postmortem
 
     att = attribute(rows_from_payloads(span_rows))
     snap = (report_row or {}).get("metrics", {})
@@ -304,10 +329,20 @@ def cmd_report(args) -> int:
     burns = {k[len("slo.burn."):]: v for k, v in gauges.items()
              if k.startswith("slo.burn.")}
     trace = path.replace(".events.jsonl", ".trace.json")
+    links = link_table(att, snapshot=snap if report_row else None)
+    # flight recorder (ISSUE 18): a crashed/SIGKILLed process leaves
+    # <run_dir>/postmortem.json next to its events file
+    pm = load_postmortem(os.path.dirname(os.path.abspath(path)))
 
     if getattr(args, "format", "text") == "json":
         out = {
-            "schema": 1,
+            # schema 2 (ISSUE 18): ADDITIVE only — every schema-1 key is
+            # still present with its schema-1 shape; "links",
+            # "postmortem", and "fleet" are the new keys
+            "schema": 2,
+            "links": links,
+            "postmortem": pm,
+            "fleet": fleet,
             "events_path": path,
             "trace_path": trace if os.path.exists(trace) else None,
             "metric_rows": n_metrics,
@@ -338,6 +373,17 @@ def cmd_report(args) -> int:
               file=sys.stderr)
     if os.path.exists(trace):
         print(f"chrome trace: {trace}  (open at ui.perfetto.dev)")
+    if pm is not None and pm.get("reason") != "finish":
+        import time as _time
+
+        died = _time.strftime("%Y-%m-%d %H:%M:%S",
+                              _time.localtime(pm.get("t", 0)))
+        print(f"POSTMORTEM: process {pm.get('process')!r} died at {died} "
+              f"({pm.get('reason')}); last span was "
+              f"{pm.get('last_span')!r} — {len(pm.get('spans') or [])} "
+              f"spans, {len(pm.get('frames') or [])} comm frames in "
+              + os.path.join(os.path.dirname(os.path.abspath(path)),
+                             "postmortem.json"))
     print(f"metric rows: {n_metrics} ({n_sysperf} sysperf)")
     if spans:
         print("spans:")
@@ -349,6 +395,10 @@ def cmd_report(args) -> int:
                   f"total={agg['total_s']:.3f}s  avg={avg_ms:.2f}ms")
     if att.get("totals"):
         print(render_table(att))
+    if links:
+        print(render_link_table(att, snapshot=snap if report_row else None))
+    if fleet is not None:
+        print(_render_fleet(fleet))
     if report_row:
         # wire codec plane (ISSUE 14): surface the payload-compression
         # ratio directly — summed over backends from the sender-side
@@ -399,6 +449,146 @@ def _fmt_bytes(n: float) -> str:
             return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
         n /= 1024
     return f"{n:.1f}TB"
+
+
+def _report_merge(args) -> int:
+    """`report --merge dirA dirB ...`: fold N run dirs' (or trace files')
+    Chrome traces into ONE clock-corrected Perfetto timeline with a flow
+    arrow per cross-process send→handle pair (utils/obsfleet.merge_traces).
+    Exit 1 if the corrected timeline still shows a recv before its send —
+    that invariant is the whole point of the correction."""
+    import os
+
+    from .utils.obsfleet import (load_trace, merge_traces,
+                                 verify_merged_order)
+
+    inputs = []
+    for spec in args.merge:
+        if os.path.isfile(spec):
+            path = spec
+            name = os.path.basename(spec).split(".")[0] or spec
+        elif os.path.isdir(spec):
+            names = [n for n in os.listdir(spec)
+                     if n.endswith(".trace.json")]
+            if not names:
+                print(f"--merge: no *.trace.json under {spec!r}",
+                      file=sys.stderr)
+                return 1
+            path = max((os.path.join(spec, n) for n in names),
+                       key=os.path.getmtime)
+            name = os.path.basename(os.path.normpath(spec))
+        else:
+            print(f"--merge: {spec!r} is neither a trace file nor a run "
+                  "dir", file=sys.stderr)
+            return 1
+        inputs.append((name, path))
+    # duplicate lane names would fold two processes into one pid label
+    counts: dict = {}
+    uniq = []
+    for name, path in inputs:
+        n = counts.get(name, 0)
+        counts[name] = n + 1
+        uniq.append((f"{name}#{n}" if n else name, path))
+    out_path = args.out or "merged.trace.json"
+    res = merge_traces(uniq, out_path=out_path)
+    bad = verify_merged_order(load_trace(out_path))
+    if getattr(args, "format", "text") == "json":
+        print(json.dumps(
+            {**{k: v for k, v in res.items() if k != "trace"},
+             "order_violations": bad}, indent=2, sort_keys=True))
+        return 0 if bad == 0 else 1
+    print(f"merged trace: {out_path}  (open at ui.perfetto.dev)")
+    print(f"processes: {len(res['processes'])} "
+          f"({', '.join(res['processes'])})  events: {res['events']}  "
+          f"send->handle pairs: {res['pairs']}  "
+          f"stitched flows: {res['flows']}")
+    if res["clock_skew_ms"]:
+        print("clock skew: " + "  ".join(
+            f"{k} {v:+.3f}ms"
+            for k, v in sorted(res["clock_skew_ms"].items())))
+    if res["clamped"]:
+        print(f"clamped events: {res['clamped']} (pair constraints "
+              "infeasible — ordering invariant enforced per event)")
+    if bad:
+        print(f"ERROR: {bad} flow(s) still show recv before the "
+              "corrected send", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _fetch_fleet(spec: str) -> dict:
+    """Fleet snapshot from a FleetCollector: a base URL (its /fleet JSON
+    endpoint — a .../metrics URL is rewritten), or a local JSON file a
+    collector's snapshot was saved to."""
+    import os
+
+    if os.path.isfile(spec):
+        with open(spec) as f:
+            return json.load(f)
+    import urllib.request
+
+    url = spec
+    if url.endswith("/metrics"):
+        url = url[:-len("/metrics")] + "/fleet"
+    elif not url.endswith("/fleet"):
+        url = url.rstrip("/") + "/fleet"
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _render_fleet(fs: dict) -> str:
+    """Per-process columns + a fleet-sums column from a FleetCollector
+    snapshot ({"processes": ..., "sums": ...}); stale processes are
+    starred in the header and called out on the status line."""
+    procs = fs.get("processes") or {}
+    sums = fs.get("sums") or {}
+    names = sorted(procs)
+
+    def fmt(v):
+        return "-" if v is None else f"{v:g}"
+
+    def cell(snap, kind, key):
+        if not snap:
+            return "-"
+        v = (snap.get(kind) or {}).get(key)
+        if v is not None and kind == "histograms":
+            v = v.get("count", 0)
+        return fmt(v)
+
+    rows = []
+    for kind, suffix in (("counters", ""), ("gauges", ""),
+                         ("histograms", " (count)")):
+        keys = set(sums.get(kind) or {})
+        for p in procs.values():
+            keys |= set(((p.get("snapshot") or {}).get(kind)) or {})
+        for k in sorted(keys):
+            sv = (sums.get(kind) or {}).get(k)
+            if sv is not None and kind == "histograms":
+                sv = sv.get("count", 0)
+            rows.append(
+                [k + suffix]
+                + [cell((procs[n].get("snapshot")), kind, k)
+                   for n in names] + [fmt(sv)])
+    head = (["metric"]
+            + [n + ("*" if procs[n].get("stale") else "") for n in names]
+            + ["fleet"])
+    widths = [max(len(str(r[i])) for r in [head] + rows)
+              for i in range(len(head))]
+    status = []
+    for n in names:
+        p = procs[n]
+        s = f"{n}=" + ("STALE" if p.get("stale") else "ok")
+        if p.get("age_s") is not None:
+            s += f" ({p['age_s']:.1f}s ago)"
+        if p.get("error"):
+            s += f" [{p['error'][:60]}]"
+        status.append(s)
+    lines = ["fleet: " + ", ".join(status)
+             + "   (* = stale: last scrape failed or too old)"]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(head, widths)))
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
 
 
 def _top_frame(snap: dict, source: str, prev: dict = None,
@@ -700,6 +890,11 @@ def cmd_top(args) -> int:
     url = args.url
     if url is None and args.port is not None:
         url = f"http://127.0.0.1:{args.port}/metrics"
+    if getattr(args, "fleet", False) and url is None:
+        print("top --fleet needs --url/--port pointing at a "
+              "FleetCollector's aggregated /metrics "
+              "(common_args.extra.obs_fleet.port)", file=sys.stderr)
+        return 2
     # the run-dir fallback reads a FINISHED run's static end-of-run
     # snapshot — looping over it would render the same frame forever
     once = args.once or url is None
@@ -750,8 +945,33 @@ def cmd_top(args) -> int:
                 _time.sleep(args.interval)
                 continue
             now = _time.monotonic()
-            text = _top_frame(snap, source, prev,
-                              (now - prev_t) if prev_t is not None else None)
+            if getattr(args, "fleet", False):
+                # fleet mode (ISSUE 18): the scraped exposition is the
+                # collector's AGGREGATE — split it back per process and
+                # render the per-process-columns table
+                from .utils.obsfleet import fleet_sums
+                from .utils.prometheus import split_by_label
+
+                split = split_by_label(snap, "process")
+                per = {k: v for k, v in split.items() if k}
+                # the collector's own (unlabeled) families carry the
+                # fleet-level staleness gauge
+                n_stale = ((split.get("") or {}).get("gauges")
+                           or {}).get("obs_fleet_stale")
+                fs = {"processes": {
+                    n: {"ok": True, "stale": False, "age_s": None,
+                        "error": None, "snapshot": s}
+                    for n, s in per.items()},
+                    "sums": fleet_sums(per)}
+                head = (f"fedml_tpu top --fleet — {source}  "
+                        f"({_time.strftime('%Y-%m-%d %H:%M:%S')})")
+                if n_stale:
+                    head += f"  STALE PROCESSES: {int(n_stale)}"
+                text = head + "\n" + _render_fleet(fs)
+            else:
+                text = _top_frame(
+                    snap, source, prev,
+                    (now - prev_t) if prev_t is not None else None)
             if not once and frame:
                 print("\x1b[2J\x1b[H", end="")  # clear screen between frames
             print(text, flush=True)
@@ -880,6 +1100,74 @@ def _cohort_sharded_check() -> dict:
         raise ValueError(f"chunk program retraced: {n_chunk} compiles")
     return {"devices": d, "chunks": int(chunks),
             "prefetched": int(prefetched), "params_bitwise": True}
+
+
+# fleet_obs_smoke children (jax-free on purpose — interpreter start must
+# stay inside the probe's 20s budget). Peers exchange reliable gRPC
+# traffic both ways (pings out, pongs back — both clock-offset directions
+# get constraints), export their Chrome traces, then serve /metrics and
+# block on stdin until the parent is done scraping. The victim arms the
+# flight recorder on a fast spill cadence and heartbeats until SIGKILLed.
+_FLEET_PEER_SRC = """\
+import json, sys, threading, time
+from fedml_tpu.comm.manager import FedCommManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.grpc_transport import GrpcTransport
+from fedml_tpu.comm.reliable import ReliableTransport, RetryPolicy
+from fedml_tpu.utils.events import recorder
+from fedml_tpu.utils.prometheus import MetricsExporter
+
+rank = {rank}
+n = {n}
+ipmap = {{0: "127.0.0.1:{port_a}", 1: "127.0.0.1:{port_b}"}}
+t = ReliableTransport(
+    GrpcTransport(rank, ipmap, port={my_port}),
+    RetryPolicy(ack_timeout_s=0.2, max_attempts=20, deadline_s=20.0))
+m = FedCommManager(t, rank)
+got = set()
+done = threading.Event()
+
+def on_msg(msg):
+    got.add(msg.get("i"))
+    if rank == 1:
+        m.send_message(Message("fleet_pong", 1, 0).add("i", msg.get("i")))
+    if len(got) >= n:
+        done.set()
+
+m.register_message_receive_handler(
+    "fleet_ping" if rank == 1 else "fleet_pong", on_msg)
+m.run(background=True)
+if rank == 0:
+    time.sleep(0.4)
+    for i in range(n):
+        m.send_message(Message("fleet_ping", 0, 1).add("i", i))
+ok = done.wait(timeout=20)
+recorder.export_chrome_trace(r"{trace}")
+exp = MetricsExporter(port=0).start()
+print(json.dumps({{"ok": bool(ok), "url": exp.url, "got": len(got)}}),
+      flush=True)
+sys.stdin.read()
+m.stop()
+"""
+
+_FLEET_VICTIM_SRC = """\
+import json, sys, time
+from fedml_tpu.utils import metrics as mx
+from fedml_tpu.utils import postmortem
+from fedml_tpu.utils.events import recorder
+from fedml_tpu.utils.prometheus import MetricsExporter
+
+postmortem.flight.spill_every_s = 0.05
+postmortem.arm(r"{run_dir}", process="victim")
+mx.inc("victim.steps")
+with recorder.span("victim.work", step=0):
+    pass
+exp = MetricsExporter(port=0).start()
+print(json.dumps({{"url": exp.url}}), flush=True)
+while True:
+    with recorder.span("victim.heartbeat"):
+        time.sleep(0.05)
+"""
 
 
 def cmd_diagnosis(args) -> int:
@@ -1729,6 +2017,147 @@ def cmd_diagnosis(args) -> int:
                 "alerts_firing": mon.firing(),
                 "elapsed_s": round(dt, 1)}
 
+    def fleet_obs_smoke():
+        # the fleet-observability plane end-to-end (ISSUE 18): three REAL
+        # child processes — two gRPC peers exchanging reliable traffic
+        # both ways and one victim — scraped by a FleetCollector into one
+        # aggregated /metrics carrying three `process` label values, the
+        # peers' traces merged into one clock-corrected timeline with >=1
+        # stitched send->handle flow and ZERO ordering violations, and
+        # the victim SIGKILLed mid-heartbeat leaving a readable
+        # postmortem naming its last span — inside a ~20s budget.
+        import os as _os
+        import signal as _sig
+        import socket as _socket
+        import subprocess as _sp
+        import tempfile as _tf
+        import threading as _th
+        import time as _t
+
+        from .utils.obsfleet import (FleetCollector, load_trace,
+                                     merge_traces, verify_merged_order)
+        from .utils.postmortem import POSTMORTEM_FILE, load_postmortem
+        from .utils.prometheus import parse_prometheus, split_by_label
+
+        t0 = _t.perf_counter()
+
+        def free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        root = _os.path.dirname(_os.path.dirname(_os.path.abspath(
+            __file__)))
+        env = {**_os.environ, "PYTHONPATH": _os.pathsep.join(
+            [root] + ([_os.environ["PYTHONPATH"]]
+                      if _os.environ.get("PYTHONPATH") else []))}
+        pa, pb = free_port(), free_port()
+
+        def spawn(src):
+            return _sp.Popen([sys.executable, "-c", src], env=env,
+                             stdin=_sp.PIPE, stdout=_sp.PIPE,
+                             stderr=_sp.PIPE, text=True)
+
+        def ready_line(p, timeout=30):
+            out: list = []
+            th = _th.Thread(
+                target=lambda: out.append(p.stdout.readline()),
+                daemon=True)
+            th.start()
+            th.join(timeout)
+            if not out or not out[0]:
+                err = (p.stderr.read()[-400:]
+                       if p.poll() is not None else "(still running)")
+                raise TimeoutError(f"child never reported ready: {err}")
+            return json.loads(out[0])
+
+        n = 4
+        with _tf.TemporaryDirectory() as d:
+            tr_a = _os.path.join(d, "a.trace.json")
+            tr_b = _os.path.join(d, "b.trace.json")
+            victim_dir = _os.path.join(d, "victim")
+            procs = [
+                spawn(_FLEET_PEER_SRC.format(
+                    rank=0, n=n, port_a=pa, port_b=pb, my_port=pa,
+                    trace=tr_a)),
+                spawn(_FLEET_PEER_SRC.format(
+                    rank=1, n=n, port_a=pa, port_b=pb, my_port=pb,
+                    trace=tr_b)),
+                spawn(_FLEET_VICTIM_SRC.format(run_dir=victim_dir))]
+            try:
+                ready = [ready_line(p) for p in procs]
+                if not (ready[0]["ok"] and ready[1]["ok"]):
+                    raise RuntimeError(f"peer exchange failed: {ready[:2]}")
+                coll = FleetCollector({"peer_a": ready[0]["url"],
+                                       "peer_b": ready[1]["url"],
+                                       "victim": ready[2]["url"]})
+                ok = coll.scrape_once()
+                if not all(ok.values()):
+                    raise RuntimeError(f"scrape failed: {ok}")
+                agg = parse_prometheus(coll.aggregated_text())
+                per = {k: v for k, v in
+                       split_by_label(agg, "process").items() if k}
+                if sorted(per) != ["peer_a", "peer_b", "victim"]:
+                    raise ValueError("aggregated /metrics missing process "
+                                     f"labels: {sorted(per)}")
+                vs = per["victim"]["counters"].get("victim_steps_total")
+                if not vs:
+                    raise ValueError("victim counter absent from the "
+                                     "aggregated view")
+                # the victim's inflight spill must exist BEFORE the kill —
+                # SIGKILL runs no handler, the spill is all that survives
+                pm_path = _os.path.join(victim_dir, POSTMORTEM_FILE)
+                deadline = _t.monotonic() + 10
+                while (not _os.path.exists(pm_path)
+                       and _t.monotonic() < deadline):
+                    _t.sleep(0.02)
+                if not _os.path.exists(pm_path):
+                    raise TimeoutError(
+                        "victim never spilled an inflight postmortem")
+                procs[2].send_signal(_sig.SIGKILL)
+                procs[2].wait(timeout=10)
+                coll.scrape_once()     # dead endpoint -> stale mark
+                fsnap = coll.fleet_snapshot()
+                if not fsnap["processes"]["victim"]["stale"]:
+                    raise ValueError("SIGKILLed victim not marked stale")
+                pm = load_postmortem(victim_dir)
+                if pm is None or "hard-kill" not in pm["reason"]:
+                    raise ValueError("postmortem unreadable or wrong "
+                                     f"reason: {pm and pm.get('reason')}")
+                if not str(pm["last_span"] or "").startswith("victim."):
+                    raise ValueError(
+                        f"postmortem last span {pm['last_span']!r}")
+                for p in procs[:2]:    # peers exit when stdin closes
+                    p.stdin.close()
+                for p in procs[:2]:
+                    p.wait(timeout=15)
+                res = merge_traces(
+                    [("peer_a", tr_a), ("peer_b", tr_b)],
+                    out_path=_os.path.join(d, "merged.trace.json"))
+                if res["flows"] < 1:
+                    raise ValueError(
+                        f"no stitched send->handle flow: {res}")
+                bad = verify_merged_order(load_trace(res["out"]))
+                if bad:
+                    raise ValueError(
+                        f"{bad} flow(s) violate corrected ordering")
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+        dt = _t.perf_counter() - t0
+        if dt > 20:
+            raise RuntimeError(
+                f"fleet obs smoke took {dt:.1f}s (budget 20s)")
+        return {"processes": sorted(per), "victim_steps": int(vs),
+                "flows": res["flows"], "order_violations": 0,
+                "clock_skew_ms": res["clock_skew_ms"],
+                "clamped": res["clamped"],
+                "postmortem_reason": pm["reason"],
+                "last_span": pm["last_span"], "elapsed_s": round(dt, 1)}
+
     probes = {"jax": jax_devices, "wire_codec": wire,
               "loopback_transport": loopback, "grpc_transport": grpc,
               "native_lib": native, "metrics_endpoint": metrics_endpoint,
@@ -1743,6 +2172,7 @@ def cmd_diagnosis(args) -> int:
               "cross_silo_durability_smoke": cross_silo_durability_smoke,
               "live_loop_smoke": live_loop_smoke,
               "attribution_smoke": attribution_smoke,
+              "fleet_obs_smoke": fleet_obs_smoke,
               "lint_clean": lint_clean}
     required = ("jax", "wire_codec", "loopback_transport", "chaos_smoke",
                 "codec_smoke",
@@ -1751,7 +2181,7 @@ def cmd_diagnosis(args) -> int:
                 "fleet_rolling_update_smoke",
                 "partition_rules_smoke", "cohort_sharded_smoke",
                 "cross_silo_durability_smoke", "live_loop_smoke",
-                "attribution_smoke", "lint_clean")
+                "attribution_smoke", "fleet_obs_smoke", "lint_clean")
     # --only: run a subset by name — a failing fleet probe can be re-run
     # in seconds instead of paying the full battery every iteration
     selected = getattr(args, "only", None) or list(probes)
@@ -1828,6 +2258,19 @@ def main(argv=None) -> int:
                     help="json emits one stable machine-readable object "
                          "(budget table, SLO/alert summary, metrics "
                          "snapshot) for CI/autoscaler consumption")
+    rp.add_argument("--merge", nargs="+", default=None, metavar="RUN_DIR",
+                    help="merge N run dirs' (or *.trace.json files') "
+                         "Chrome traces into ONE clock-corrected Perfetto "
+                         "timeline with cross-process send->handle flow "
+                         "arrows; exits 1 if a recv still precedes its "
+                         "corrected send")
+    rp.add_argument("--out", default=None,
+                    help="--merge output path (default merged.trace.json)")
+    rp.add_argument("--fleet", default=None, metavar="URL",
+                    help="FleetCollector URL (or saved /fleet JSON file): "
+                         "fold the live fleet snapshot — per-process "
+                         "columns, fleet sums, staleness marks — into "
+                         "the report")
     tp = sub.add_parser("top",
                         help="live one-screen run health from a /metrics "
                              "endpoint (or a finished run's events file)")
@@ -1845,6 +2288,10 @@ def main(argv=None) -> int:
                     help="render one frame and exit")
     tp.add_argument("--frames", type=int, default=0,
                     help="stop after N frames (0 = run until ^C)")
+    tp.add_argument("--fleet", action="store_true",
+                    help="treat --url/--port as a FleetCollector's "
+                         "AGGREGATED /metrics and render per-process "
+                         "columns instead of the single-process frame")
     args = p.parse_args(argv)
     return {"version": cmd_version, "env": cmd_env, "run": cmd_run,
             "bench": cmd_bench, "launch": cmd_launch, "build": cmd_build,

@@ -4,7 +4,9 @@ The repo's observability contract is stringly typed: `utils/metrics.py`
 instruments by dotted name (`fed.*` / `serving.*` / `comm.*` / `xla.*`,
 the live-loop soak's `soak.*` / `loadgen.*` — ISSUE 15 — and the
 attribution plane's `slo.*` burn-rate alerts + `events.*` trace-drop
-counters — ISSUE 17),
+counters — ISSUE 17 — and the fleet-observability plane's `obs.*`
+collector/clock-skew/postmortem families — ISSUE 18; per-link comm
+telemetry rides the existing `comm.` family as `comm.link.*`),
 `utils/prometheus.py` sanitizes those to exposition names
 (`fed_rounds_total`), and the `top` verb + README document them back to
 operators. Nothing ties the three together — a typo'd emit or a renamed
@@ -40,13 +42,13 @@ from .core import (
 )
 
 _FAMILIES = ("fed", "serving", "comm", "xla", "soak", "loadgen", "slo",
-             "events")
+             "events", "obs")
 _RAW_RE = re.compile(
-    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events)\.[a-z0-9_.]*$")
+    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events|obs)\.[a-z0-9_.]*$")
 _SAN_RE = re.compile(
-    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events)_[a-z0-9_]+$")
+    r"^(?:fed|serving|comm|xla|soak|loadgen|slo|events|obs)_[a-z0-9_]+$")
 _DOC_RE = re.compile(
-    r"`((?:fed|serving|comm|xla|soak|loadgen|slo|events)\.[^`\s]+)`")
+    r"`((?:fed|serving|comm|xla|soak|loadgen|slo|events|obs)\.[^`\s]+)`")
 _INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 
 # method name -> instrument kind

@@ -18,9 +18,25 @@ import logging
 import time
 
 from ..utils import metrics as _mx
+from ..utils import postmortem as _pm
 from .message import Message
 
 _log = logging.getLogger(__name__)
+
+# per-link byte accounting (ISSUE 18): `comm.link.<src>.<dst>.bytes`
+# counters from the same encode choke point that feeds the per-backend
+# counters. A module toggle so the fleet-observability bench row can
+# measure the plane's cost honestly (on vs off).
+_link_telemetry = True
+
+
+def set_link_telemetry(on: bool) -> None:
+    global _link_telemetry
+    _link_telemetry = bool(on)
+
+
+def link_telemetry_enabled() -> bool:
+    return _link_telemetry
 
 
 class Observer(abc.ABC):
@@ -105,6 +121,11 @@ class BaseTransport(abc.ABC):
         _mx.observe(f"{pre}.serialize_s", time.perf_counter() - t0)
         _mx.inc(f"{pre}.bytes_sent", len(frame))
         _mx.inc(f"{pre}.msgs_sent")
+        if _link_telemetry:
+            _mx.inc(f"comm.link.{msg.sender_id}.{msg.receiver_id}.bytes",
+                    len(frame))
+        _pm.note_frame("send", msg.type, msg.sender_id, msg.receiver_id,
+                       len(frame), msg.headers())
         return frame
 
     def _decode_frame(self, frame: bytes) -> Message:
@@ -120,6 +141,8 @@ class BaseTransport(abc.ABC):
         _mx.observe(f"{pre}.deserialize_s", time.perf_counter() - t0)
         _mx.inc(f"{pre}.bytes_recv", len(frame))
         _mx.inc(f"{pre}.msgs_recv")
+        _pm.note_frame("recv", msg.type, msg.sender_id, msg.receiver_id,
+                       len(frame), msg.headers())
         return msg
 
     @abc.abstractmethod

@@ -93,6 +93,16 @@ class FedCommManager(Observer):
                                backend=self.backend):
                 handler(msg)
 
+    def announce_metrics(self, process: str, url: str,
+                         collector_rank: int = 0) -> None:
+        """Self-register this process's /metrics endpoint with the fleet
+        collector's host (ISSUE 18): one OBS_REGISTER frame over this
+        manager's transport. The collector side routes the frame via
+        `obsfleet.install_registration(manager, collector)`."""
+        from ..utils.obsfleet import announce
+
+        announce(self, process, url, collector_rank)
+
     def run(self, background: bool = False) -> None:
         """Enter the receive loop (reference: run() :25 →
         handle_receive_message). background=True runs it in a daemon thread

@@ -67,6 +67,14 @@ class Message:
         return (self.params.get(ARG_TRACE_ID),
                 self.params.get(ARG_PARENT_SPAN))
 
+    def headers(self) -> dict:
+        """The underscore-prefixed header entries (trace context, reliable
+        seq/epoch/ts, ...) WITHOUT the payload — what the crash flight
+        recorder keeps per frame (utils/postmortem.py): small, scalar, and
+        enough to reconstruct 'what was in flight' after a kill."""
+        return {k: v for k, v in self.params.items()
+                if isinstance(k, str) and k.startswith("_")}
+
     def encode(self) -> bytes:
         return serialization.encode({
             ARG_TYPE: self.type,

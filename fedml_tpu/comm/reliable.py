@@ -45,6 +45,7 @@ from typing import Optional
 from ..utils import metrics as _mx
 from ..utils.events import recorder
 from .base import BaseTransport, Observer
+from .base import link_telemetry_enabled as _link_rtt_enabled
 from .message import Message
 
 log = logging.getLogger(__name__)
@@ -60,6 +61,12 @@ HDR_SEQ = "_rel_seq"
 #: and acks echo the epoch so a stale pre-restart ack can't satisfy a
 #: post-restart send.
 HDR_EPOCH = "_rel_epoch"
+#: sender-clock transmit timestamp, echoed verbatim in the ack (ISSUE 18):
+#: the sender measures link RTT against its OWN monotonic clock, so no
+#: cross-process clock agreement is needed. Restamped on every transmit
+#: (Karn's rule) — an ack always echoes the attempt that actually landed,
+#: never an earlier attempt's stamp inflated by backoff.
+HDR_TS = "_rel_ts"
 
 
 class DeliveryError(RuntimeError):
@@ -243,6 +250,7 @@ class ReliableTransport(BaseTransport, Observer):
         return base * (1.0 + p.jitter * (2.0 * self._jitter_rng.random() - 1.0))
 
     def _transmit(self, msg: Message) -> None:
+        msg.params[HDR_TS] = time.perf_counter()
         try:
             self.inner.send_message(msg)
         except Exception as e:  # noqa: BLE001 — retried in the background
@@ -294,11 +302,13 @@ class ReliableTransport(BaseTransport, Observer):
             item = self._ack_q.get()
             if item is None:
                 return
-            peer, seq, epoch = item
+            peer, seq, epoch, ts = item
+            params = {HDR_SEQ: seq, HDR_EPOCH: epoch}
+            if ts is not None:
+                params[HDR_TS] = ts      # echo: RTT on the sender's clock
             try:
                 self.inner.send_message(
-                    Message(REL_ACK, self.rank, peer,
-                            {HDR_SEQ: seq, HDR_EPOCH: epoch}))
+                    Message(REL_ACK, self.rank, peer, params))
             except Exception as e:  # noqa: BLE001
                 _mx.inc("comm.rel.ack_send_errors")
                 log.debug("rank %s: ack %d to %s failed: %s: %s", self.rank,
@@ -316,6 +326,15 @@ class ReliableTransport(BaseTransport, Observer):
                     if fresh and seq is not None else None
             _mx.inc("comm.rel.acked" if ent is not None
                     else "comm.rel.stale_acks")
+            ts = msg.get(HDR_TS)
+            if ent is not None and ts is not None and _link_rtt_enabled():
+                # every acked frame yields a measured per-link RTT: the
+                # echo is this process's own perf_counter stamp, so the
+                # subtraction never crosses clock domains
+                _mx.registry.histogram(
+                    f"comm.link.{self.rank}.{msg.sender_id}.rtt_ms",
+                    _mx.RTT_BUCKETS_MS).observe(
+                    (time.perf_counter() - float(ts)) * 1e3)
             return
         seq = msg.get(HDR_SEQ)
         if seq is None:
@@ -329,7 +348,7 @@ class ReliableTransport(BaseTransport, Observer):
         # unreachable peer can't stall the transport pump this runs on.
         # The ack itself is unprotected: data-frame retransmission already
         # covers ack loss.
-        self._ack_q.put((msg.sender_id, seq, epoch))
+        self._ack_q.put((msg.sender_id, seq, epoch, msg.get(HDR_TS)))
         with self._lock:
             window = self._seen.get(msg.sender_id)
             if window is None or window[0] != epoch:

@@ -526,6 +526,15 @@ class Config:
             from .soak.knobs import validate_soak
 
             validate_soak(sk)
+        # fleet-observability plane (ISSUE 18): `common_args.extra.obs_fleet`
+        # (roster/port/cadence) validated by its owning module — a typo'd
+        # roster or port fails at load, not as a fleet view that silently
+        # never aggregates. Lazy import, jax-free by design.
+        of = self.common_args.extra.get("obs_fleet")
+        if of is not None:
+            from .utils.obsfleet import validate_obs_fleet
+
+            validate_obs_fleet(of)
         # wire codec plane (ISSUE 14): `comm_args.comm_codec` is validated
         # by its owning module against the CODEC_KNOBS registry (pure
         # literal, graftlint's knob-drift rule cross-checks the consumer) —

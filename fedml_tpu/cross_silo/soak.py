@@ -167,6 +167,12 @@ class SiloSoakHarness:
         # process deaths ride fed.chaos.silo_kills, the serving tier's
         # ride fed.chaos.replica_kills (inference_runner._chaos_tick)
         _mx.inc("fed.chaos.silo_kills")
+        # chaos kill events leave postmortems too (ISSUE 18): when a
+        # flight recorder is armed, the kill flushes the ring naming what
+        # the process was doing when the timeline severed it
+        from ..utils.postmortem import record_kill
+
+        record_kill("server rank 0")
         self._dead.append(srv)
         self.server = None
 
@@ -178,6 +184,9 @@ class SiloSoakHarness:
         if th is not None:
             th.join(timeout=10)
         _mx.inc("fed.chaos.silo_kills")
+        from ..utils.postmortem import record_kill
+
+        record_kill(f"client rank {cid}")
         self._dead.append(c)
 
     # ------------------------------------------------------------- helpers

@@ -66,6 +66,13 @@ def init(cfg, sysperf_interval: Optional[float] = None) -> None:
         # the run's log/events files (ISSUE 2 — a tracked run produces an
         # openable trace with zero user code)
         _state["trace_run"] = (t.log_file_dir, t.run_name)
+        # crash flight recorder (ISSUE 18): arm it at the run dir so a
+        # SIGTERM'd/crashed tracked run leaves <log_dir>/postmortem.json.
+        # Respect an already-armed recorder — outer harnesses own it then.
+        from .utils import postmortem
+
+        if postmortem.flight.armed_dir is None:
+            postmortem.arm(t.log_file_dir, process=str(t.run_name))
     # model-artifact store (reference: log_aggregated_model_info uploads to
     # S3; here tracking_args.extra picks the sink):
     #   artifact_store: "file" (default when artifact_dir set) | "broker"
@@ -235,6 +242,18 @@ def _finish_report() -> None:
         except Exception as e:  # noqa: BLE001
             logging.getLogger(__name__).warning(
                 "chrome-trace export failed: %s: %s", type(e).__name__, e)
+        # a clean finish writes the final postmortem (reason "finish")
+        # and stops the inflight spill — the run dir never keeps a stale
+        # "inflight" document that report would misread as a hard kill
+        try:
+            from .utils import postmortem
+
+            if postmortem.flight.armed_dir == run[0]:
+                postmortem.flight.flush("finish")
+                postmortem.flight.disarm()
+        except Exception as e:  # noqa: BLE001 — never block run teardown
+            logging.getLogger(__name__).warning(
+                "postmortem flush failed: %s: %s", type(e).__name__, e)
 
 
 def finish() -> None:

@@ -784,6 +784,22 @@ class InferenceGateway:
     def inflight(self) -> int:
         return self._inflight.value()
 
+    def fleet_roster(self) -> dict:
+        """{process: /metrics url} for this gateway and every replica it
+        knows — the serving tier's contribution to the fleet-observability
+        roster (utils/obsfleet.FleetCollector consumes it directly). The
+        gateway already knows its replicas' endpoints; a FleetCollector
+        pointed here sees the whole serving fleet without extra config."""
+        host = self._server.server_address[0]
+        roster = {"gateway": f"http://{host}:{self.port}/metrics"}
+        with self.dep._lock:
+            reps = list(self.dep.replicas)
+        for i, rep in enumerate(reps):
+            if rep.endpoint:
+                name = rep.replica_id or f"replica{i}"
+                roster[name] = rep.endpoint.rstrip("/") + "/metrics"
+        return roster
+
     # --------------------------------------------------- admission control
     def _overloaded(self) -> bool:
         """True when fleet-wide depth has crossed the shed watermark.

@@ -348,11 +348,18 @@ class LiveLoopHarness:
         coverage of the final fleet (short training runs otherwise leave
         a thin request sample). Returns the report dict
         (slo.evaluate_slo output + loop facts)."""
+        from ..utils import postmortem
         from ..utils.attribution import analyze_and_publish
         from ..utils.slo import SloMonitor, default_specs
         from .loadgen import LoadGenerator
         from .slo import evaluate_slo
 
+        # arm the crash flight recorder at the artifact root (ISSUE 18):
+        # the chaos timeline's silo kills flush postmortems there, and an
+        # OS-level death of the whole harness leaves the inflight spill.
+        # Respect an already-armed recorder — a parent harness may own it.
+        if postmortem.flight.armed_dir is None:
+            postmortem.arm(str(self.store.root), process="live-loop")
         self.warmup()
         self._watcher = threading.Thread(target=self._watch, daemon=True)
         self._watcher.start()

@@ -103,6 +103,8 @@ def rows_from_recorder(rec=None) -> list[dict]:
                      "dur": max(s.duration, 0.0),
                      "round": s.meta.get("round"),
                      "backend": s.meta.get("backend"),
+                     "sender": s.meta.get("sender"),
+                     "receiver": s.meta.get("receiver"),
                      "span_id": s.span_id, "parent_id": s.parent_id})
     return rows
 
@@ -119,6 +121,8 @@ def rows_from_payloads(payloads: Iterable[dict]) -> list[dict]:
         rows.append({"name": p["name"], "t0": float(t),
                      "dur": max(float(p.get("duration", 0.0)), 0.0),
                      "round": p.get("round"), "backend": p.get("backend"),
+                     "sender": p.get("sender"),
+                     "receiver": p.get("receiver"),
                      "span_id": p.get("span_id", ""),
                      "parent_id": p.get("parent_id", "")})
     return rows
@@ -128,6 +132,7 @@ def rows_from_payloads(payloads: Iterable[dict]) -> list[dict]:
 def _window_budget(rows: list[dict], a: float, b: float) -> dict:
     per_cat: dict[str, list] = {c: [] for c in _CATEGORIES}
     backends: dict[str, float] = {}
+    links: dict[str, float] = {}
     for r in rows:
         lo = max(r["t0"], a)
         hi = min(r["t0"] + r["dur"], b)
@@ -139,6 +144,13 @@ def _window_budget(rows: list[dict], a: float, b: float) -> dict:
         if cat == "transport":
             bk = r.get("backend") or "unknown"
             backends[bk] = backends.get(bk, 0.0) + (hi - lo)
+            # per-link breakout (ISSUE 18 leg c): comm.send/comm.handle
+            # spans carry sender/receiver meta; key as "src->dst" so the
+            # budget table splits transport per link, not just backend
+            snd, rcv = r.get("sender"), r.get("receiver")
+            if snd is not None and rcv is not None:
+                key = f"{snd}->{rcv}"
+                links[key] = links.get(key, 0.0) + (hi - lo)
     claimed: list = []
     out: dict = {}
     for cat in _CATEGORIES:
@@ -152,6 +164,8 @@ def _window_budget(rows: list[dict], a: float, b: float) -> dict:
                               if wall > 0 else 0.0)
     out["transport_by_backend"] = {k: round(v, 6)
                                    for k, v in sorted(backends.items())}
+    out["transport_by_link"] = {k: round(v, 6)
+                                for k, v in sorted(links.items())}
     return out
 
 
@@ -248,6 +262,66 @@ def render_table(att: dict) -> str:
     if cp:
         lines.append("critical path: " + " > ".join(
             f"{s['name']} {s['dur']:.3f}s" for s in cp[:6]))
+    return "\n".join(lines)
+
+
+def link_table(att: dict, snapshot: Optional[dict] = None) -> list[dict]:
+    """Per-link transport rows: the time-share from the span budget joined
+    with the `comm.link.<src>.<dst>.{bytes,rtt_ms}` instruments (ISSUE 18).
+    One row per link seen by EITHER surface — a link can have bytes but no
+    spans (acks ride below the span layer) and vice versa."""
+    totals = att.get("totals") or {}
+    by_link = dict(totals.get("transport_by_link") or {})
+    wall = float(totals.get("wall_s") or 0.0)
+    snap = snapshot or {}
+    counters = snap.get("counters") or {}
+    hists = snap.get("histograms") or {}
+    link_bytes: dict[str, float] = {}
+    link_rtt: dict[str, dict] = {}
+    for name, v in counters.items():
+        parts = name.split(".")
+        if name.startswith("comm.link.") and len(parts) == 5 \
+                and parts[4] == "bytes":
+            link_bytes[f"{parts[2]}->{parts[3]}"] = v
+    for name, h in hists.items():
+        parts = name.split(".")
+        if name.startswith("comm.link.") and len(parts) == 5 \
+                and parts[4] == "rtt_ms":
+            link_rtt[f"{parts[2]}->{parts[3]}"] = h
+    rows = []
+    for link in sorted(set(by_link) | set(link_bytes) | set(link_rtt)):
+        t = by_link.get(link, 0.0)
+        h = link_rtt.get(link) or {}
+        rows.append({
+            "link": link,
+            "transport_s": round(t, 6),
+            "share": round(t / wall, 4) if wall > 0 else 0.0,
+            "bytes": int(link_bytes.get(link, 0)),
+            "rtt_ms_p50": h.get("p50"),
+            "rtt_ms_p99": h.get("p99"),
+            "rtt_count": h.get("count", 0),
+        })
+    return rows
+
+
+def render_link_table(att: dict, snapshot: Optional[dict] = None) -> str:
+    """The report's per-link transport table."""
+    rows = link_table(att, snapshot)
+    if not rows:
+        return "per-link transport: no links observed"
+    lines = ["per-link transport (share = fraction of wall time that "
+             "link's spans were in flight):",
+             f"{'link':>10}  {'transport_s':>11}  {'share':>6}  "
+             f"{'bytes':>10}  {'rtt_p50':>8}  {'rtt_p99':>8}  {'acks':>6}"]
+    for r in rows:
+        p50 = f"{r['rtt_ms_p50']:.2f}ms" if r["rtt_ms_p50"] is not None \
+            else "-"
+        p99 = f"{r['rtt_ms_p99']:.2f}ms" if r["rtt_ms_p99"] is not None \
+            else "-"
+        lines.append(
+            f"{r['link']:>10}  {r['transport_s']:>11.3f}  "
+            f"{r['share']:>6.1%}  {r['bytes']:>10}  {p50:>8}  {p99:>8}  "
+            f"{r['rtt_count']:>6}")
     return "\n".join(lines)
 
 

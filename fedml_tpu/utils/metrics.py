@@ -38,6 +38,16 @@ LATENCY_BUCKETS_S = (
     1.0, 2.0, 5.0, 10.0, 30.0, 60.0,
 )
 
+# RTT buckets in MILLISECONDS: 10µs loopback ack .. 10s WAN timeout. The
+# per-link `comm.link.<src>.<dst>.rtt_ms` histograms (ISSUE 18) observe
+# milliseconds, so the seconds-scale LATENCY_BUCKETS_S would collapse every
+# loopback ack into its bottom bucket.
+RTT_BUCKETS_MS = (
+    0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
+    10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0, 10000.0,
+)
+
 
 class Counter:
     """Monotonic counter. `inc` touches only the calling thread's shard —

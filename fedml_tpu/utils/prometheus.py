@@ -55,47 +55,178 @@ def _fmt(v: float) -> str:
     return repr(v) if isinstance(v, float) else str(v)
 
 
-def render_prometheus(snapshot: Optional[dict] = None) -> str:
+# ------------------------------------------------------------- label sets
+# Full label-set support (ISSUE 18): the fleet collector re-renders each
+# process's parsed snapshot with a `process` label, so the renderer and
+# parser must round-trip arbitrary label sets — escaping, multi-label,
+# stable (sorted-by-key, `le` last) ordering — not just histogram `le`.
+# Series identity is the canonical string `name{a="x",b="y"}`; snapshot
+# dicts may use these identity strings as keys and everything downstream
+# (render, parse, split_by_label) agrees on that convention. Label-less
+# snapshots render byte-identically to the pre-label format.
+
+def escape_label_value(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def format_labels(labels: Optional[dict]) -> str:
+    """Canonical `{a="x",b="y"}` rendering: keys sorted, except `le`
+    always LAST (Prometheus convention for bucket series). Empty -> ""."""
+    if not labels:
+        return ""
+    keys = sorted(k for k in labels if k != "le")
+    if "le" in labels:
+        keys.append("le")
+    inner = ",".join(
+        f'{k}="{escape_label_value(labels[k])}"' for k in keys)
+    return "{" + inner + "}"
+
+
+def parse_labels(s: Optional[str]) -> dict:
+    """Inverse of `format_labels` on the text INSIDE the braces. Handles
+    escaped `\\"`, `\\\\`, `\\n` in values; raises ValueError on anything
+    a round trip could not have produced."""
+    out: dict = {}
+    if not s:
+        return out
+    i, n = 0, len(s)
+    while i < n:
+        j = s.find("=", i)
+        if j < 0 or j + 1 >= n or s[j + 1] != '"':
+            raise ValueError(f"malformed label set: {s!r}")
+        key = s[i:j].strip()
+        if not key or _INVALID.search(key):
+            raise ValueError(f"malformed label name {key!r} in {s!r}")
+        i = j + 2
+        buf = []
+        while i < n:
+            c = s[i]
+            if c == "\\":
+                if i + 1 >= n:
+                    raise ValueError(f"dangling escape in {s!r}")
+                nxt = s[i + 1]
+                buf.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            i += 1
+        if i >= n or s[i] != '"':
+            raise ValueError(f"unterminated label value in {s!r}")
+        out[key] = "".join(buf)
+        i += 1
+        if i < n:
+            if s[i] != ",":
+                raise ValueError(f"expected ',' after label in {s!r}")
+            i += 1
+    return out
+
+
+def series_key(name: str, labels: Optional[dict] = None) -> str:
+    """Canonical series identity: `name{a="x"}`; bare name when no
+    labels. Snapshot dict keys use exactly this form."""
+    return name + format_labels(labels)
+
+
+def split_series_key(key: str) -> tuple[str, dict]:
+    """Inverse of `series_key`."""
+    brace = key.find("{")
+    if brace < 0:
+        return key, {}
+    if not key.endswith("}"):
+        raise ValueError(f"malformed series key: {key!r}")
+    return key[:brace], parse_labels(key[brace + 1:-1])
+
+
+def _merged_key(raw_key: str, extra: Optional[dict]) -> tuple[str, dict]:
+    base, lbls = split_series_key(raw_key)
+    if extra:
+        lbls = {**lbls, **extra}
+    return base, lbls
+
+
+def render_prometheus(snapshot: Optional[dict] = None,
+                      labels: Optional[dict] = None) -> str:
     """One registry snapshot as Prometheus text exposition. Counters gain
     the conventional `_total` suffix; histograms emit CUMULATIVE bucket
     counts (the registry stores per-bucket counts) with a closing
-    `le="+Inf"` bucket equal to `_count`."""
+    `le="+Inf"` bucket equal to `_count`.
+
+    `labels` (e.g. `{"process": "server"}`) is attached to EVERY sample;
+    snapshot keys that are already series identities (`name{a="x"}`)
+    keep their own labels merged under the extra ones. Histogram values
+    accept either the registry form (`edges`/`counts`) or the parsed
+    form (cumulative `buckets`), so a parsed snapshot re-renders."""
     snap = snapshot if snapshot is not None else mx.snapshot()
     lines: list[str] = []
-    for name in sorted(snap.get("counters", {})):
-        n = sanitize_name(name)
+    seen_meta: set[str] = set()
+
+    def sort_key(raw: str) -> tuple[str, str]:
+        base, lbls = _merged_key(raw, labels)
+        return base, format_labels(lbls)
+
+    def meta(n: str, kind: str, raw_base: str) -> None:
+        if n not in seen_meta:
+            seen_meta.add(n)
+            lines.append(f"# HELP {n} fedml_tpu {kind} {raw_base}")
+            lines.append(f"# TYPE {n} {kind}")
+
+    for name in sorted(snap.get("counters", {}), key=sort_key):
+        base, lbls = _merged_key(name, labels)
+        n = sanitize_name(base)
         if not n.endswith("_total"):
             n += "_total"
-        lines += [f"# HELP {n} fedml_tpu counter {name}",
-                  f"# TYPE {n} counter",
-                  f"{n} {_fmt(snap['counters'][name])}"]
-    for name in sorted(snap.get("gauges", {})):
-        n = sanitize_name(name)
-        lines += [f"# HELP {n} fedml_tpu gauge {name}",
-                  f"# TYPE {n} gauge",
-                  f"{n} {_fmt(float(snap['gauges'][name]))}"]
-    for name in sorted(snap.get("histograms", {})):
+        meta(n, "counter", base)
+        lines.append(
+            f"{n}{format_labels(lbls)} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap.get("gauges", {}), key=sort_key):
+        base, lbls = _merged_key(name, labels)
+        n = sanitize_name(base)
+        meta(n, "gauge", base)
+        lines.append(
+            f"{n}{format_labels(lbls)} "
+            f"{_fmt(float(snap['gauges'][name]))}")
+    for name in sorted(snap.get("histograms", {}), key=sort_key):
         h = snap["histograms"][name]
-        n = sanitize_name(name)
-        lines += [f"# HELP {n} fedml_tpu histogram {name}",
-                  f"# TYPE {n} histogram"]
-        cum = 0
-        counts = h.get("counts") or []
-        edges = h.get("edges") or []
-        for edge, c in zip(edges, counts):
-            cum += c
-            lines.append(f'{n}_bucket{{le="{_fmt(float(edge))}"}} {cum}')
-        if len(counts) > len(edges):      # overflow bucket
-            cum += counts[len(edges)]
-        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{n}_sum {_fmt(float(h.get('sum', 0.0)))}")
+        base, lbls = _merged_key(name, labels)
+        n = sanitize_name(base)
+        meta(n, "histogram", base)
+        if "buckets" in h:                # parsed (cumulative) form
+            cum = 0
+            for le, c in h["buckets"]:
+                cum = int(c)
+                if math.isinf(le):
+                    break
+                lines.append(
+                    f"{n}_bucket"
+                    f"{format_labels({**lbls, 'le': _fmt(float(le))})} "
+                    f"{cum}")
+        else:                             # registry (per-bucket) form
+            cum = 0
+            counts = h.get("counts") or []
+            edges = h.get("edges") or []
+            for edge, c in zip(edges, counts):
+                cum += c
+                lines.append(
+                    f"{n}_bucket"
+                    f"{format_labels({**lbls, 'le': _fmt(float(edge))})} "
+                    f"{cum}")
+            if len(counts) > len(edges):      # overflow bucket
+                cum += counts[len(edges)]
+        lines.append(
+            f"{n}_bucket{format_labels({**lbls, 'le': '+Inf'})} {cum}")
+        lines.append(
+            f"{n}_sum{format_labels(lbls)} "
+            f"{_fmt(float(h.get('sum', 0.0)))}")
         # _count is emitted as the accumulated bucket total, NOT the
         # snapshot's separate count field: the lock-free shards update
         # buckets and count as distinct ops, so a torn scrape could read
         # them one observation apart — deriving _count from the buckets
         # keeps the exposition self-consistent (parse_prometheus enforces
         # +Inf == _count) at every instant
-        lines.append(f"{n}_count {cum}")
+        lines.append(f"{n}_count{format_labels(lbls)} {cum}")
     return "\n".join(lines) + "\n"
 
 
@@ -107,7 +238,10 @@ def parse_prometheus(text: str) -> dict:
     """Parse text exposition back into
     {"counters": {name: v}, "gauges": {name: v},
      "histograms": {name: {"count", "sum", "buckets": [(le, cum), ...]}}}.
-    Names stay in their sanitized exposition form (counters keep `_total`).
+    Names stay in their sanitized exposition form (counters keep `_total`);
+    labeled samples key under their series identity (`name{a="x"}`, labels
+    sorted — see `series_key`), so the same family scraped from N
+    processes parses into N distinct, individually-validated series.
     Raises ValueError on malformed sample lines, so tests using it really
     do validate the format."""
     types: dict[str, str] = {}
@@ -128,41 +262,64 @@ def parse_prometheus(text: str) -> dict:
         if not m:
             raise ValueError(f"line {lineno}: malformed sample: {line!r}")
         name, labels, raw = m.groups()
+        try:
+            lbls = parse_labels(labels)
+        except ValueError as e:
+            raise ValueError(f"line {lineno}: malformed sample: {e}")
         value = float(raw.replace("+Inf", "inf").replace("-Inf", "-inf"))
         base = re.sub(r"_(bucket|sum|count)$", "", name)
         if types.get(base) == "histogram":
+            le_s = lbls.pop("le", None)
+            key = series_key(base, lbls)
             h = out["histograms"].setdefault(
-                base, {"count": 0, "sum": 0.0, "buckets": []})
+                key, {"count": 0, "sum": 0.0, "buckets": []})
             if name.endswith("_bucket"):
-                lem = re.search(r'le="([^"]+)"', labels or "")
-                if not lem:
+                if le_s is None:
                     raise ValueError(
                         f"line {lineno}: histogram bucket without le label")
-                le = float(lem.group(1).replace("+Inf", "inf"))
+                le = float(le_s.replace("+Inf", "inf"))
                 h["buckets"].append((le, value))
             elif name.endswith("_sum"):
                 h["sum"] = value
             elif name.endswith("_count"):
                 h["count"] = int(value)
             continue
+        key = series_key(name, lbls)
         if types.get(name) == "counter":
-            out["counters"][name] = value
+            out["counters"][key] = value
         else:
-            out["gauges"][name] = value
-    # cumulative bucket sanity: monotone, +Inf == count
-    for base, h in out["histograms"].items():
+            out["gauges"][key] = value
+    # cumulative bucket sanity per series: monotone, +Inf == count
+    for skey, h in out["histograms"].items():
         prev = 0.0
         for le, cum in h["buckets"]:
             if cum < prev:
                 raise ValueError(
-                    f"{base}: non-monotonic cumulative bucket at le={le}")
+                    f"{skey}: non-monotonic cumulative bucket at le={le}")
             prev = cum
         if h["buckets"] and not math.isinf(h["buckets"][-1][0]):
-            raise ValueError(f"{base}: missing le=\"+Inf\" bucket")
+            raise ValueError(f"{skey}: missing le=\"+Inf\" bucket")
         if h["buckets"] and int(h["buckets"][-1][1]) != h["count"]:
             raise ValueError(
-                f"{base}: +Inf bucket {h['buckets'][-1][1]} != "
+                f"{skey}: +Inf bucket {h['buckets'][-1][1]} != "
                 f"count {h['count']}")
+    return out
+
+
+def split_by_label(parsed: dict, label: str = "process") -> dict:
+    """Group a parsed (or aggregated) snapshot by one label's value:
+    {value: snapshot-with-that-label-stripped}. Series that do not carry
+    the label land under "" — the fleet collector's own families, or a
+    plain single-process scrape. The inverse of rendering N per-process
+    snapshots with `labels={"process": name}` into one exposition."""
+    out: dict = {}
+    for section in ("counters", "gauges", "histograms"):
+        for skey, v in (parsed.get(section) or {}).items():
+            base, lbls = split_series_key(skey)
+            who = lbls.pop(label, "")
+            snap = out.setdefault(
+                who, {"counters": {}, "gauges": {}, "histograms": {}})
+            snap[section][series_key(base, lbls)] = v
     return out
 
 

@@ -75,6 +75,24 @@ def _isolate_xla_ledger():
 
 
 @pytest.fixture(autouse=True)
+def _isolate_flight_recorder():
+    """The crash flight recorder (utils/postmortem.py, ISSUE 18) is a
+    process-global ring + arm state; a test that arms it must not leave
+    the spill thread pointed at its (deleted) tmp dir for the next test.
+    Disarm and clear the rings afterwards; re-enable in case a test
+    toggled it off."""
+    from fedml_tpu.utils import postmortem as pm
+
+    yield
+    if pm.flight.armed_dir is not None:
+        pm.flight.disarm()
+    pm.flight._spans.clear()
+    pm.flight._frames.clear()
+    pm.flight.set_enabled(True)
+    pm.flight.process = "main"
+
+
+@pytest.fixture(autouse=True)
 def _isolate_metrics_registry():
     """The recorder fixture above left the process-global MetricsRegistry
     (utils/metrics.py) shared across tests, so counter assertions (e.g.

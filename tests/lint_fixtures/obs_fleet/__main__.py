@@ -1,0 +1,18 @@
+"""graftlint fixture: ISSUE 18 consumer surfaces (a miniature `top`
+fleet line + report-style raw snapshot reads). Never imported — parsed
+by the linter only."""
+
+
+def _top_frame(snap):
+    c, g = snap["counters"], snap["gauges"]
+    scrapes = c.get("obs_fleet_scrape_errors_total", 0)
+    skews = {k: v for k, v in g.items()
+             if k.startswith("obs_clock_skew_ms_")}
+    ghost = g.get("obs_fleet_lag_s", 0)          # FINDING: never emitted
+    return scrapes, skews, ghost
+
+
+def report(snap):
+    flushed = snap["counters"].get("obs.postmortem.flushes", 0)
+    spilled = snap["counters"].get("obs.postmortem.spills", 0)  # FINDING: never emitted
+    return flushed, spilled

@@ -1,0 +1,28 @@
+"""graftlint fixture: metric-registry coverage of the ISSUE 18 families
+(`obs.*` fleet-collector/clock-skew/postmortem series, `comm.link.*`
+per-link telemetry). Never imported — parsed by the linter only."""
+from utils import metrics as mx
+
+
+def scrape(ok):
+    mx.inc("obs.fleet.scrapes")
+    mx.inc("obs.fleet.scrape_errors")
+    mx.set_gauge("obs.fleet.stale", 0 if ok else 1)
+
+
+def scrape_typo():
+    mx.inc("obs.fleet.scrape_error")             # FINDING: 1 edit from established
+
+
+def skew(a, b, ms):
+    mx.set_gauge(f"obs.clock_skew_ms.{a}.{b}", ms)   # prefix emit
+
+
+def link(src, dst, nbytes, rtt):
+    mx.inc(f"comm.link.{src}.{dst}.bytes", nbytes)
+    mx.observe(f"comm.link.{src}.{dst}.rtt_ms", rtt)
+
+
+def flush():
+    mx.inc("obs.postmortem.flushes")
+    mx.inc("obs.postmortem.kills")

@@ -318,12 +318,16 @@ class TestReportCli:
         ev = _write_events(tmp_path / "r.events.jsonl")
         assert main(["report", "--events", ev, "--format", "json"]) == 0
         out = json.loads(capsys.readouterr().out)
-        # the stable machine-readable shape (satellite 1)
-        assert out["schema"] == 1
-        assert set(out) == {"schema", "events_path", "trace_path",
-                            "metric_rows", "sysperf_rows", "spans",
-                            "budget", "slo", "dropped_spans_total",
-                            "headline", "metrics"}
+        # the stable machine-readable shape: schema 2 (ISSUE 18) is
+        # strictly additive over schema 1 — every schema-1 key survives
+        # with its meaning intact, new keys ride alongside
+        assert out["schema"] == 2
+        schema1 = {"schema", "events_path", "trace_path",
+                   "metric_rows", "sysperf_rows", "spans",
+                   "budget", "slo", "dropped_spans_total",
+                   "headline", "metrics"}
+        assert schema1 <= set(out)
+        assert set(out) == schema1 | {"links", "postmortem", "fleet"}
         assert out["budget"]["totals"]["transport_share"] > 0
         assert out["budget"]["totals"]["transport_by_backend"] == \
             {"loopback": 0.5}

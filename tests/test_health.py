@@ -343,10 +343,19 @@ def test_serving_runner_exposes_metrics_route():
             data=json.dumps({"inputs": np.zeros((2, 8)).tolist()}).encode(),
             headers={"Content-Type": "application/json"})
         urllib.request.urlopen(req, timeout=10).read()
-        text = urllib.request.urlopen(
-            f"http://127.0.0.1:{runner.port}/metrics",
-            timeout=5).read().decode()
-        parsed = parse_prometheus(text)
+        # the request_s observe runs in the handler's `finally` AFTER the
+        # response bytes are flushed, so an immediate scrape can race the
+        # handler thread by a few microseconds — poll briefly
+        deadline = time.monotonic() + 5
+        while True:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{runner.port}/metrics",
+                timeout=5).read().decode()
+            parsed = parse_prometheus(text)
+            if "serving_request_s" in parsed["histograms"] or \
+                    time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
         assert parsed["counters"].get("serving_requests_total", 0) >= 1
         assert "serving_request_s" in parsed["histograms"]
     finally:

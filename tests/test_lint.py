@@ -223,6 +223,28 @@ def test_metric_registry_slo_events_families():
     assert "events.evicted_total" in msgs
 
 
+def test_metric_registry_obs_fleet_families():
+    """The fleet-observability families (ISSUE 18): `obs.*` names are
+    first-class to the rule — prefix emits (`obs.clock_skew_ms.<a>.<b>`,
+    `comm.link.<src>.<dst>.*`) satisfy prefix reads, a near-miss
+    `obs.fleet.scrape_error` typo and ghost reads (`obs_fleet_lag_s` in
+    a top frame, `obs.postmortem.spills` in a raw snapshot read) all
+    surface."""
+    findings, _stats = _lint_fixture("obs_fleet", "metric-registry")
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(os.path.basename(f.path), set()).add(f.line)
+    assert by_path.pop("emit.py") == _marked_lines("obs_fleet", "emit.py")
+    assert by_path.pop("__main__.py") == _marked_lines("obs_fleet",
+                                                       "__main__.py")
+    assert not by_path
+    msgs = " ".join(f.message for f in findings)
+    assert "obs.fleet.scrape_error" in msgs \
+        and "obs.fleet.scrape_errors" in msgs
+    assert "obs_fleet_lag_s" in msgs
+    assert "obs.postmortem.spills" in msgs
+
+
 def test_metric_registry_spans_do_not_satisfy_scrape_reads():
     # a span name must NOT satisfy a `top`/snapshot consumer — spans never
     # reach /metrics. The doc surface (where span names are legitimate)

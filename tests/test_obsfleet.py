@@ -1,0 +1,505 @@
+"""Fleet observability (ISSUE 18): label-set exposition round-trips,
+cross-process metric federation, clock-corrected trace merging, per-link
+comm telemetry, and the crash flight recorder.
+
+Everything here is CPU-fast and jax-free: the exposition layer is pure
+string work, the collector takes an injected fetch, the comm tests ride
+the in-process loopback transport, and the one subprocess test only
+imports numpy-level fedml_tpu."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from fedml_tpu.utils import metrics as mx
+from fedml_tpu.utils import postmortem as pm
+from fedml_tpu.utils.events import recorder
+from fedml_tpu.utils.obsfleet import (
+    FleetCollector, announce, fleet_sums, install_registration,
+    merge_traces, validate_obs_fleet, verify_merged_order,
+)
+from fedml_tpu.utils.prometheus import (
+    format_labels, parse_labels, parse_prometheus, render_prometheus,
+    series_key, split_by_label, split_series_key,
+)
+
+
+# ----------------------------------------------------- exposition + labels
+_SNAP = {
+    "counters": {"fed.rounds": 3},
+    "gauges": {"fed.round.current": 2.0},
+    "histograms": {"fed.round_s": {"count": 3, "sum": 0.75,
+                                   "edges": [0.1, 0.5],
+                                   "counts": [1, 2, 0]}},
+}
+
+# the pre-label format, byte for byte: satellite 1's compatibility pin —
+# adding label support must not move a single character of label-less
+# output (dashboards and the golden tests scrape this exact text)
+_GOLDEN = """\
+# HELP fed_rounds_total fedml_tpu counter fed.rounds
+# TYPE fed_rounds_total counter
+fed_rounds_total 3
+# HELP fed_round_current fedml_tpu gauge fed.round.current
+# TYPE fed_round_current gauge
+fed_round_current 2
+# HELP fed_round_s fedml_tpu histogram fed.round_s
+# TYPE fed_round_s histogram
+fed_round_s_bucket{le="0.1"} 1
+fed_round_s_bucket{le="0.5"} 3
+fed_round_s_bucket{le="+Inf"} 3
+fed_round_s_sum 0.75
+fed_round_s_count 3
+"""
+
+
+class TestExpositionLabels:
+    def test_labelless_output_byte_identical_golden(self):
+        assert render_prometheus(_SNAP) == _GOLDEN
+
+    def test_label_ordering_sorted_le_last(self):
+        s = format_labels({"le": "0.5", "b": "2", "a": "1"})
+        assert s == '{a="1",b="2",le="0.5"}'
+
+    def test_label_escaping_roundtrip(self):
+        ugly = {"path": 'a"b\\c\nd', "plain": "ok"}
+        inner = format_labels(ugly)[1:-1]
+        assert parse_labels(inner) == ugly
+
+    def test_series_key_split_inverse(self):
+        key = series_key("comm.bytes", {"process": "p0", "dir": "tx"})
+        base, lbls = split_series_key(key)
+        assert (base, lbls) == ("comm.bytes", {"process": "p0",
+                                               "dir": "tx"})
+        assert split_series_key("plain_name") == ("plain_name", {})
+
+    def test_labeled_render_parse_fixpoint(self):
+        text = render_prometheus(_SNAP, labels={"process": "p0"})
+        parsed = parse_prometheus(text)
+        assert parsed["counters"]['fed_rounds_total{process="p0"}'] == 3
+        # fixpoint: a parsed snapshot re-renders to the same parse
+        assert parse_prometheus(render_prometheus(parsed)) == parsed
+
+    def test_split_by_label_inverts_aggregation(self):
+        text_a = render_prometheus(_SNAP, labels={"process": "a"})
+        text_b = render_prometheus(_SNAP, labels={"process": "b"})
+        merged = parse_prometheus(text_a + text_b)
+        per = split_by_label(merged, "process")
+        assert set(per) == {"a", "b"}
+        bare = parse_prometheus(render_prometheus(_SNAP))
+        assert per["a"] == bare and per["b"] == bare
+
+    @pytest.mark.parametrize("text,frag", [
+        ("fed_x_total 1\nnot a sample", "line 2"),
+        ('fed_x{a=b} 1', "malformed"),
+        ('fed_x{a="b} 1', "malformed"),
+        ("# TYPE h histogram\n"
+         'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\nh_count 3\nh_sum 0',
+         "non-monotonic"),
+        ("# TYPE h histogram\nh_bucket{le=\"1\"} 2\nh_count 2\nh_sum 0",
+         "missing"),
+        ("# TYPE h histogram\n"
+         'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 2\nh_count 5\nh_sum 0',
+         "count"),
+    ])
+    def test_malformed_exposition_is_loud(self, text, frag):
+        with pytest.raises(ValueError, match=frag):
+            parse_prometheus(text)
+
+
+# --------------------------------------------------------- FleetCollector
+def _expo(counters):
+    return render_prometheus(
+        {"counters": counters, "gauges": {}, "histograms": {}})
+
+
+class TestFleetCollector:
+    def test_scrape_aggregate_split_roundtrip(self):
+        texts = {"http://a/metrics": render_prometheus(_SNAP),
+                 "http://b/metrics": _expo({"fed.rounds": 7})}
+        coll = FleetCollector({"a": "http://a/metrics",
+                               "b": "http://b/metrics"},
+                              fetch=lambda u: texts[u])
+        assert coll.scrape_once() == {"a": True, "b": True}
+        per = split_by_label(parse_prometheus(coll.aggregated_text()))
+        assert set(per) == {"a", "b"}
+        assert per["a"]["counters"]["fed_rounds_total"] == 3
+        assert per["b"]["counters"]["fed_rounds_total"] == 7
+        assert per["a"]["histograms"]["fed_round_s"]["count"] == 3
+
+    def test_fleet_sums_equal_sum_of_per_process_scrapes(self):
+        snap_a = parse_prometheus(render_prometheus(_SNAP))
+        snap_b = parse_prometheus(render_prometheus(_SNAP))
+        texts = {"http://a/metrics": render_prometheus(_SNAP),
+                 "http://b/metrics": render_prometheus(_SNAP)}
+        coll = FleetCollector({"a": "http://a/metrics",
+                               "b": "http://b/metrics"},
+                              fetch=lambda u: texts[u])
+        coll.scrape_once()
+        sums = coll.fleet_snapshot()["sums"]
+        # pinned: the fleet column IS the sum of the per-process scrapes
+        assert sums == fleet_sums({"a": snap_a, "b": snap_b})
+        assert sums["counters"]["fed_rounds_total"] == 6
+        h = sums["histograms"]["fed_round_s"]
+        assert h["count"] == 6 and h["sum"] == 1.5
+        assert h["buckets"][-1] == (float("inf"), 6.0)
+
+    def test_failed_scrape_keeps_snapshot_and_marks_stale(self):
+        texts = {"http://a/metrics": _expo({"fed.rounds": 1})}
+        fail = [False]
+
+        def fetch(url):
+            if fail[0]:
+                raise OSError("connection refused")
+            return texts[url]
+
+        coll = FleetCollector({"a": "http://a/metrics"}, fetch=fetch)
+        assert coll.scrape_once() == {"a": True}
+        assert not coll.fleet_snapshot()["processes"]["a"]["stale"]
+        fail[0] = True
+        assert coll.scrape_once() == {"a": False}
+        ent = coll.fleet_snapshot()["processes"]["a"]
+        assert ent["stale"] and "refused" in ent["error"]
+        # last-good snapshot survives the failure for the columns
+        assert ent["snapshot"]["counters"]["fed_rounds_total"] == 1
+
+    def test_never_scraped_process_is_stale_with_reason(self):
+        coll = FleetCollector({"ghost": "http://ghost/metrics"},
+                              fetch=lambda u: _expo({}))
+        ent = coll.fleet_snapshot()["processes"]["ghost"]
+        assert ent["stale"] and ent["error"] == "never scraped"
+
+    def test_http_serve_metrics_and_fleet(self):
+        texts = {"http://a/metrics": _expo({"fed.rounds": 5})}
+        coll = FleetCollector({"a": "http://a/metrics"},
+                              fetch=lambda u: texts[u])
+        coll.scrape_once()
+        exp = coll.serve(port=0)
+        try:
+            with urllib.request.urlopen(exp.url, timeout=5) as r:
+                body = r.read().decode()
+            per = split_by_label(parse_prometheus(body))
+            assert per["a"]["counters"]["fed_rounds_total"] == 5
+            fleet_url = exp.url.rsplit("/", 1)[0] + "/fleet"
+            with urllib.request.urlopen(fleet_url, timeout=5) as r:
+                doc = json.loads(r.read())
+            assert doc["processes"]["a"]["ok"]
+            assert doc["sums"]["counters"]["fed_rounds_total"] == 5
+        finally:
+            coll.stop()
+
+    def test_registration_over_loopback(self):
+        from fedml_tpu.comm import FedCommManager
+        from fedml_tpu.comm.loopback import LoopbackTransport, \
+            release_router
+
+        run = "obsfleet-reg"
+        a = FedCommManager(LoopbackTransport(0, run), 0)
+        b = FedCommManager(LoopbackTransport(1, run), 1)
+        coll = FleetCollector()
+        install_registration(a, coll)
+        a.run(background=True)
+        b.run(background=True)
+        try:
+            announce(b, "rank1", "http://127.0.0.1:9999/metrics")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and "rank1" not in \
+                    coll.roster():
+                time.sleep(0.01)
+            assert coll.roster() == {
+                "rank1": "http://127.0.0.1:9999/metrics"}
+        finally:
+            a.stop()
+            b.stop()
+            release_router(run)
+
+    def test_validate_obs_fleet_rejects_garbage(self):
+        ok = {"roster": {"a": "http://a/metrics"}, "port": 0,
+              "interval_s": 1.0, "timeout_s": 2.0, "stale_after_s": 5.0}
+        assert validate_obs_fleet(ok) is ok
+        for bad in ({"rooster": {}},
+                    {"roster": {"a": 1}},
+                    {"port": 70000},
+                    {"port": True},
+                    {"interval_s": -1},
+                    {"stale_after_s": float("nan")}):
+            with pytest.raises(ValueError):
+                validate_obs_fleet(bad)
+
+
+# -------------------------------------------------- per-link comm telemetry
+class TestLinkTelemetry:
+    def _pair(self, run):
+        from fedml_tpu.comm import FedCommManager, ReliableTransport
+        from fedml_tpu.comm.loopback import LoopbackTransport
+        from fedml_tpu.comm.reliable import RetryPolicy
+
+        policy = RetryPolicy(ack_timeout_s=5.0)
+        a = FedCommManager(
+            ReliableTransport(LoopbackTransport(0, run), policy), 0)
+        b = FedCommManager(
+            ReliableTransport(LoopbackTransport(1, run), policy), 1)
+        return a, b
+
+    def test_link_bytes_and_ack_echo_rtt(self):
+        from fedml_tpu.comm import Message
+        from fedml_tpu.comm.loopback import release_router
+
+        run = "obsfleet-rtt"
+        a, b = self._pair(run)
+        got = []
+        b.register_message_receive_handler(
+            "m", lambda m: got.append(m.get("i")))
+        a.run(background=True)
+        b.run(background=True)
+        try:
+            for i in range(5):
+                a.send_message(Message("m", 0, 1).add("i", i))
+            assert a.transport.flush(10) and not a.transport.failed
+        finally:
+            a.stop()
+            b.stop()
+            release_router(run)
+        snap = mx.snapshot()
+        assert snap["counters"]["comm.link.0.1.bytes"] > 0
+        # every acked data frame yields one same-clock RTT sample
+        rtt = snap["histograms"]["comm.link.0.1.rtt_ms"]
+        assert rtt["count"] >= 5
+        assert rtt["p99"] is not None
+
+    def test_link_telemetry_toggle_is_honored(self):
+        from fedml_tpu.comm import Message
+        from fedml_tpu.comm.base import set_link_telemetry
+        from fedml_tpu.comm.loopback import release_router
+
+        run = "obsfleet-rtt-off"
+        a, b = self._pair(run)
+        a.run(background=True)
+        b.run(background=True)
+        set_link_telemetry(False)
+        try:
+            a.send_message(Message("m", 0, 1).add("i", 0))
+            assert a.transport.flush(10)
+        finally:
+            set_link_telemetry(True)
+            a.stop()
+            b.stop()
+            release_router(run)
+        snap = mx.snapshot()
+        assert not any(k.startswith("comm.link.")
+                       for k in snap["counters"])
+        assert not any(k.startswith("comm.link.")
+                       for k in snap["histograms"])
+
+    def test_link_table_joins_spans_and_instruments(self):
+        from fedml_tpu.utils.attribution import link_table, \
+            render_link_table
+
+        att = {"totals": {"wall_s": 2.0,
+                          "transport_by_link": {"0->1": 0.5}}}
+        snap = {"counters": {"comm.link.0.1.bytes": 4096,
+                             "comm.link.1.0.bytes": 128},
+                "histograms": {"comm.link.0.1.rtt_ms": {
+                    "count": 9, "sum": 18.0, "p50": 1.5, "p99": 4.0}}}
+        rows = {r["link"]: r for r in link_table(att, snap)}
+        # one row per link seen by EITHER surface
+        assert set(rows) == {"0->1", "1->0"}
+        assert rows["0->1"] == {"link": "0->1", "transport_s": 0.5,
+                                "share": 0.25, "bytes": 4096,
+                                "rtt_ms_p50": 1.5, "rtt_ms_p99": 4.0,
+                                "rtt_count": 9}
+        assert rows["1->0"]["bytes"] == 128
+        assert rows["1->0"]["rtt_ms_p50"] is None
+        text = render_link_table(att, snap)
+        assert "0->1" in text and "4096" in text and "1.50ms" in text
+
+
+# ------------------------------------------------------------ trace merge
+def _trace(tmp_path, name, events):
+    p = tmp_path / f"{name}.trace.json"
+    p.write_text(json.dumps({"traceEvents": events}))
+    return str(p)
+
+
+def _send(ts, span_id, peer, dur=10):
+    return {"ph": "X", "name": f"comm.send.ping", "ts": ts, "dur": dur,
+            "pid": 0, "tid": 1, "args": {"span_id": span_id,
+                                         "receiver": peer}}
+
+
+def _handle(ts, parent_id, dur=10):
+    return {"ph": "X", "name": f"comm.handle.ping", "ts": ts, "dur": dur,
+            "pid": 0, "tid": 2, "args": {"parent_id": parent_id}}
+
+
+class TestMergeTraces:
+    def test_midpoint_offset_recovery_and_flows(self, tmp_path):
+        # B's trace clock runs 100_000 µs ahead of A's. One message each
+        # way: a→b bounds the offset above (100_500), b→a below (99_800);
+        # the midpoint estimate is 100_150 µs.
+        pa = _trace(tmp_path, "A", [
+            _send(1000, "sA1", 1),
+            _handle(5000, "sB1"),
+        ])
+        pb = _trace(tmp_path, "B", [
+            _handle(101500, "sA1"),
+            _send(104800, "sB1", 0),
+        ])
+        out = str(tmp_path / "merged.trace.json")
+        res = merge_traces([("A", pa), ("B", pb)], out_path=out)
+        assert res["pairs"] == 2 and res["flows"] == 2
+        assert res["clamped"] == 0
+        assert res["offsets_us"] == [0.0, 100150.0]
+        assert res["clock_skew_ms"] == {"A->B": 100.15}
+        assert mx.snapshot()["gauges"]["obs.clock_skew_ms.A.B"] == 100.15
+        doc = json.load(open(out))
+        assert verify_merged_order(doc) == 0
+        # per-process pid lanes with the input names
+        lanes = {ev["pid"]: ev["args"]["name"] for ev in
+                 doc["traceEvents"]
+                 if ev.get("ph") == "M" and ev["name"] == "process_name"}
+        assert lanes == {0: "A", 1: "B"}
+        assert doc["otherData"]["clock_skew_ms"] == {"A->B": 100.15}
+
+    def test_infeasible_constraints_clamp_but_never_reorder(self, tmp_path):
+        # lower bound (99_800) above upper bound (99_100): no offset can
+        # satisfy both directions — the midpoint leaves each recv 350 µs
+        # before its send, and the invariant wins by clamping both.
+        pa = _trace(tmp_path, "A", [
+            _send(1000, "sA1", 1),
+            _handle(5000, "sB1"),
+        ])
+        pb = _trace(tmp_path, "B", [
+            _handle(100100, "sA1"),
+            _send(104800, "sB1", 0),
+        ])
+        res = merge_traces([("A", pa), ("B", pb)])
+        assert res["clamped"] == 2
+        assert verify_merged_order(res["trace"]) == 0
+
+    def test_one_direction_uses_tight_bound(self, tmp_path):
+        pa = _trace(tmp_path, "A", [_send(1000, "sA1", 1)])
+        pb = _trace(tmp_path, "B", [_handle(101500, "sA1")])
+        res = merge_traces([("A", pa), ("B", pb)])
+        assert res["offsets_us"] == [0.0, 100500.0]
+        assert verify_merged_order(res["trace"]) == 0
+
+    def test_unpaired_processes_merge_uncorrected(self, tmp_path):
+        pa = _trace(tmp_path, "A", [_send(1000, "sA1", 1)])
+        pb = _trace(tmp_path, "B", [{"ph": "X", "name": "train",
+                                     "ts": 50, "dur": 5, "pid": 0,
+                                     "tid": 0, "args": {}}])
+        res = merge_traces([("A", pa), ("B", pb)])
+        assert res["flows"] == 0 and res["offsets_us"] == [0.0, 0.0]
+        assert {"A", "B"} == set(res["processes"])
+
+
+# -------------------------------------------------------- flight recorder
+_SIGTERM_CHILD = """
+import sys, time
+from fedml_tpu.utils import postmortem as pm
+from fedml_tpu.utils.events import recorder
+pm.arm(sys.argv[1], process="victim")
+with recorder.span("victim.final"):
+    pass
+print("ready", flush=True)
+time.sleep(30)
+"""
+
+
+class TestFlightRecorder:
+    def test_ring_captures_spans_frames_and_metric_deltas(self, tmp_path):
+        pm.flight.arm(str(tmp_path), process="p0",
+                      install_handlers=False)
+        with recorder.span("obsfleet.test.step"):
+            pass
+        pm.note_frame("send", "grad", 0, 1, 128, {"seq": 7})
+        mx.inc("fed.test.obsfleet", 2)
+        doc = pm.flight.snapshot("probe")
+        assert doc["last_span"] == "obsfleet.test.step"
+        assert doc["process"] == "p0"
+        f = [fr for fr in doc["frames"] if fr["type"] == "grad"]
+        assert f and f[0]["bytes"] == 128
+        assert f[0]["headers"] == {"seq": 7}
+        # deltas are vs the arm-time baseline, not absolute counters
+        assert doc["metric_deltas"]["fed.test.obsfleet"] == 2
+
+    def test_flush_writes_postmortem_with_reason(self, tmp_path):
+        pm.flight.arm(str(tmp_path), process="p0",
+                      install_handlers=False)
+        with recorder.span("obsfleet.final"):
+            pass
+        path = pm.flight.flush("manual")
+        assert path == str(tmp_path / "postmortem.json")
+        doc = pm.load_postmortem(str(tmp_path))
+        assert doc["reason"] == "manual"
+        assert doc["last_span"] == "obsfleet.final"
+        assert mx.snapshot()["counters"]["obs.postmortem.flushes"] == 1
+
+    def test_inflight_spill_survives_as_hard_kill(self, tmp_path):
+        pm.flight.spill_every_s = 0.05
+        try:
+            pm.flight.arm(str(tmp_path), process="p0",
+                          install_handlers=False)
+            with recorder.span("obsfleet.spilled"):
+                pass
+            deadline = time.monotonic() + 5
+            path = tmp_path / "postmortem.json"
+            while time.monotonic() < deadline and not path.exists():
+                time.sleep(0.02)
+            assert path.exists(), "spill cadence never wrote"
+            doc = pm.load_postmortem(str(tmp_path))
+            # an inflight spill reads back as a hard kill: the process
+            # never reached a graceful flush
+            assert doc["reason"].startswith("hard-kill")
+        finally:
+            pm.flight.spill_every_s = 1.0
+
+    def test_record_kill_flushes_when_armed(self, tmp_path):
+        pm.flight.arm(str(tmp_path), process="silo1",
+                      install_handlers=False)
+        assert pm.record_kill("rank1")
+        doc = pm.load_postmortem(str(tmp_path))
+        assert doc["reason"] == "kill:rank1"
+        assert mx.snapshot()["counters"]["obs.postmortem.kills"] == 1
+
+    def test_disabled_ring_appends_nothing(self, tmp_path):
+        pm.flight.set_enabled(False)
+        with recorder.span("obsfleet.invisible"):
+            pass
+        pm.flight.set_enabled(True)
+        doc = pm.flight.snapshot("probe")
+        assert all(s.get("name") != "obsfleet.invisible"
+                   for s in doc["spans"])
+
+    def test_load_postmortem_absent_or_corrupt_is_none(self, tmp_path):
+        assert pm.load_postmortem(str(tmp_path)) is None
+        (tmp_path / "postmortem.json").write_text("{not json")
+        assert pm.load_postmortem(str(tmp_path)) is None
+
+    @pytest.mark.skipif(sys.platform == "win32", reason="posix signals")
+    def test_sigterm_flushes_postmortem_in_real_process(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SIGTERM_CHILD, str(tmp_path)],
+            stdout=subprocess.PIPE, env=env, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "ready"
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        doc = pm.load_postmortem(str(tmp_path))
+        assert doc is not None and doc["reason"] == "sigterm"
+        assert doc["process"] == "victim"
+        assert doc["last_span"] == "victim.final"
